@@ -1,0 +1,18 @@
+"""babble_tpu — a TPU-native hashgraph consensus framework.
+
+A ground-up rebuild of the capabilities of the Go `babble` consensus
+middleware (reference: /root/reference) designed for TPU execution:
+
+- The consensus core (ancestry reachability, round division, virtual
+  voting, total ordering) is expressed twice: an incremental host
+  engine (`babble_tpu.hashgraph`) with exact reference semantics, and a
+  batched JAX engine (`babble_tpu.ops`) that computes the same results
+  as dense tensor sweeps on an HBM-resident event-DAG, vmappable across
+  simulated peers and shardable across a device mesh.
+- The node runtime (gossip agent, transports, app proxies, service,
+  CLI) mirrors the reference's layer map (SURVEY.md §1) in Python.
+
+Reference layer map: see /root/repo/SURVEY.md.
+"""
+
+__version__ = "0.1.0"

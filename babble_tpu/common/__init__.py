@@ -1,0 +1,5 @@
+from .errors import StoreError, StoreErrType, is_store_err
+from .lru import LRU
+from .rolling_index import RollingIndex
+
+__all__ = ["StoreError", "StoreErrType", "is_store_err", "LRU", "RollingIndex"]

@@ -1,0 +1,23 @@
+from .keys import (
+    generate_key,
+    key_from_seed,
+    pub_key_bytes,
+    pub_key_from_bytes,
+    sign,
+    verify,
+    sha256,
+)
+from .pem import PemKey, generate_pem_key, PemDump
+
+__all__ = [
+    "generate_key",
+    "key_from_seed",
+    "pub_key_bytes",
+    "pub_key_from_bytes",
+    "sign",
+    "verify",
+    "sha256",
+    "PemKey",
+    "generate_pem_key",
+    "PemDump",
+]

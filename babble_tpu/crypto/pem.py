@@ -1,0 +1,55 @@
+"""PEM persistence of node keys.
+
+Reference: crypto/pem_key.go:14-99 — `priv_key.pem` holding a SEC1
+"EC PRIVATE KEY" block; `GeneratePemKey` returns the public key as
+"0x"-prefixed uppercase hex of the uncompressed point plus the PEM text.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.serialization import (
+    Encoding,
+    NoEncryption,
+    PrivateFormat,
+    load_pem_private_key,
+)
+
+from .keys import generate_key, pub_key_bytes
+
+PEM_KEY_PATH = "priv_key.pem"
+
+
+def _key_to_pem(key: ec.EllipticCurvePrivateKey) -> bytes:
+    # TraditionalOpenSSL for EC == SEC1 "EC PRIVATE KEY", same as Go
+    # x509.MarshalECPrivateKey.
+    return key.private_bytes(Encoding.PEM, PrivateFormat.TraditionalOpenSSL, NoEncryption())
+
+
+class PemKey:
+    def __init__(self, base: str):
+        self.path = os.path.join(base, PEM_KEY_PATH)
+
+    def read_key(self) -> ec.EllipticCurvePrivateKey:
+        with open(self.path, "rb") as f:
+            data = f.read()
+        return load_pem_private_key(data, password=None)
+
+    def write_key(self, key: ec.EllipticCurvePrivateKey) -> None:
+        with open(self.path, "wb") as f:
+            f.write(_key_to_pem(key))
+
+
+@dataclass
+class PemDump:
+    public_key: str
+    private_key: str
+
+
+def generate_pem_key() -> PemDump:
+    key = generate_key()
+    pub = "0x" + pub_key_bytes(key).hex().upper()
+    return PemDump(public_key=pub, private_key=_key_to_pem(key).decode("ascii"))

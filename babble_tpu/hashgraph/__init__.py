@@ -1,0 +1,28 @@
+from .event import Event, EventBody, EventCoordinates, WireBody, WireEvent
+from .block import Block
+from .root import Root, new_base_root
+from .frame import Frame
+from .round_info import RoundInfo, RoundEvent, Trilean
+from .store import Store
+from .inmem_store import InmemStore
+from .graph import Hashgraph
+from .participant_events import ParticipantEventsCache
+
+__all__ = [
+    "Event",
+    "EventBody",
+    "EventCoordinates",
+    "WireBody",
+    "WireEvent",
+    "Block",
+    "Root",
+    "new_base_root",
+    "Frame",
+    "RoundInfo",
+    "RoundEvent",
+    "Trilean",
+    "Store",
+    "InmemStore",
+    "Hashgraph",
+    "ParticipantEventsCache",
+]

@@ -1,0 +1,42 @@
+"""Commit unit: the transactions of one received round.
+
+Reference: hashgraph/block.go:11-61 — {RoundReceived, Transactions} with
+a SHA-256 hash over the Go-JSON encoding.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .. import crypto
+from ..gojson import GoStruct, marshal
+
+
+class Block(GoStruct):
+    go_fields = (
+        ("RoundReceived", "round_received"),
+        ("Transactions", "transactions"),
+    )
+
+    def __init__(self, round_received: int, transactions: Optional[List[bytes]]):
+        self.round_received = round_received
+        self.transactions = transactions
+        self._hash = b""
+        self._hex = ""
+
+    def marshal(self) -> bytes:
+        return marshal(self)
+
+    def hash(self) -> bytes:
+        if not self._hash:
+            self._hash = crypto.sha256(self.marshal())
+        return self._hash
+
+    def hex(self) -> str:
+        if not self._hex:
+            self._hex = "0x" + self.hash().hex().upper()
+        return self._hex
+
+    def __repr__(self) -> str:
+        ntx = len(self.transactions) if self.transactions else 0
+        return f"Block(rr={self.round_received}, txs={ntx})"

@@ -1,0 +1,299 @@
+"""Signed gossip events and their compact wire form.
+
+Reference: hashgraph/event.go. An event body carries the payload
+transactions, the two parent hashes (self-parent first), the creator's
+public key, a claimed timestamp, and the creator-sequence index
+(event.go:14-27). The body hash (SHA-256 of its Go-JSON encoding,
+event.go:48-54) is what gets ECDSA-signed; the full event hash (Go-JSON
+of {Body, R, S}, event.go:171-180) names the event everywhere
+("0x"-prefixed uppercase hex, event.go:182-188).
+
+Wire form (event.go:252-267) replaces the two 64-char parent hashes with
+four small ints resolved against each side's per-participant event
+indexes (reference hashgraph.go:532-614).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import List, Optional, Sequence
+
+from .. import crypto
+from ..gojson import BigInt, GoStruct, Timestamp, ZERO_TIME, decode_byte_slices, marshal
+
+
+class EventCoordinates:
+    """(hash, index) pointer used in the per-participant coordinate
+    vectors — reference event.go:56-59."""
+
+    __slots__ = ("hash", "index")
+
+    def __init__(self, hash: str = "", index: int = 0):
+        self.hash = hash
+        self.index = index
+
+    def copy(self) -> "EventCoordinates":
+        return EventCoordinates(self.hash, self.index)
+
+    def __repr__(self) -> str:
+        return f"Coord({self.index},{self.hash[:10]})"
+
+
+class EventBody(GoStruct):
+    go_fields = (
+        ("Transactions", "transactions"),
+        ("Parents", "parents"),
+        ("Creator", "creator"),
+        ("Timestamp", "timestamp"),
+        ("Index", "index"),
+    )
+
+    def __init__(
+        self,
+        transactions: Optional[List[bytes]],
+        parents: List[str],
+        creator: bytes,
+        timestamp: Timestamp,
+        index: int,
+    ):
+        self.transactions = transactions  # None == Go nil slice (marshals null)
+        self.parents = parents
+        self.creator = creator
+        self.timestamp = timestamp
+        self.index = index
+        # wire info — unexported in Go, not part of the JSON encoding
+        self.self_parent_index = -1
+        self.other_parent_creator_id = -1
+        self.other_parent_index = -1
+        self.creator_id = -1
+
+    def marshal(self) -> bytes:
+        return marshal(self)
+
+    def hash(self) -> bytes:
+        return crypto.sha256(self.marshal())
+
+
+class Event(GoStruct):
+    go_fields = (
+        ("Body", "body"),
+        ("R", "r"),
+        ("S", "s"),
+    )
+
+    def __init__(self, body: EventBody, r: int = 0, s: int = 0):
+        self.body = body
+        self.r = BigInt(r)
+        self.s = BigInt(s)
+
+        self.topological_index = 0
+        self.round_received: Optional[int] = None
+        self.consensus_timestamp: Timestamp = ZERO_TIME
+
+        self.last_ancestors: List[EventCoordinates] = []
+        self.first_descendants: List[EventCoordinates] = []
+
+        self._creator_hex: str = ""
+        self._hash: bytes = b""
+        self._hex: str = ""
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def new(
+        cls,
+        transactions: Optional[Sequence[bytes]],
+        parents: Sequence[str],
+        creator: bytes,
+        index: int,
+        timestamp: Optional[Timestamp] = None,
+    ) -> "Event":
+        body = EventBody(
+            transactions=list(transactions) if transactions is not None else None,
+            parents=list(parents),
+            creator=creator,
+            timestamp=timestamp if timestamp is not None else Timestamp.now(),
+            index=index,
+        )
+        return cls(body)
+
+    # -- accessors ---------------------------------------------------------
+
+    def creator(self) -> str:
+        if not self._creator_hex:
+            self._creator_hex = "0x" + self.body.creator.hex().upper()
+        return self._creator_hex
+
+    def self_parent(self) -> str:
+        return self.body.parents[0]
+
+    def other_parent(self) -> str:
+        return self.body.parents[1]
+
+    def transactions(self) -> Optional[List[bytes]]:
+        return self.body.transactions
+
+    def index(self) -> int:
+        return self.body.index
+
+    def is_loaded(self) -> bool:
+        """Payload-carrying, or the creator's initial event — event.go:119-126."""
+        if self.body.index == 0:
+            return True
+        return bool(self.body.transactions)
+
+    # -- crypto ------------------------------------------------------------
+
+    def sign(self, key) -> None:
+        r, s = crypto.sign(key, self.body.hash())
+        self.r, self.s = BigInt(r), BigInt(s)
+        self._hash = b""
+        self._hex = ""
+
+    def verify(self) -> bool:
+        pub = crypto.pub_key_from_bytes(self.body.creator)
+        return crypto.verify(pub, self.body.hash(), self.r, self.s)
+
+    # -- identity ----------------------------------------------------------
+
+    def marshal(self) -> bytes:
+        return marshal(self)
+
+    def hash(self) -> bytes:
+        if not self._hash:
+            self._hash = crypto.sha256(self.marshal())
+        return self._hash
+
+    def hex(self) -> str:
+        if not self._hex:
+            self._hex = "0x" + self.hash().hex().upper()
+        return self._hex
+
+    # -- consensus bookkeeping --------------------------------------------
+
+    def set_round_received(self, rr: int) -> None:
+        self.round_received = rr
+
+    def set_wire_info(
+        self,
+        self_parent_index: int,
+        other_parent_creator_id: int,
+        other_parent_index: int,
+        creator_id: int,
+    ) -> None:
+        self.body.self_parent_index = self_parent_index
+        self.body.other_parent_creator_id = other_parent_creator_id
+        self.body.other_parent_index = other_parent_index
+        self.body.creator_id = creator_id
+
+    def to_wire(self) -> "WireEvent":
+        return WireEvent(
+            body=WireBody(
+                transactions=self.body.transactions,
+                self_parent_index=self.body.self_parent_index,
+                other_parent_creator_id=self.body.other_parent_creator_id,
+                other_parent_index=self.body.other_parent_index,
+                creator_id=self.body.creator_id,
+                timestamp=self.body.timestamp,
+                index=self.body.index,
+            ),
+            r=self.r,
+            s=self.s,
+        )
+
+    def __repr__(self) -> str:
+        return f"Event({self.creator()[:10]}#{self.index()})"
+
+
+class WireBody(GoStruct):
+    go_fields = (
+        ("Transactions", "transactions"),
+        ("SelfParentIndex", "self_parent_index"),
+        ("OtherParentCreatorID", "other_parent_creator_id"),
+        ("OtherParentIndex", "other_parent_index"),
+        ("CreatorID", "creator_id"),
+        ("Timestamp", "timestamp"),
+        ("Index", "index"),
+    )
+
+    def __init__(
+        self,
+        transactions: Optional[List[bytes]],
+        self_parent_index: int,
+        other_parent_creator_id: int,
+        other_parent_index: int,
+        creator_id: int,
+        timestamp: Timestamp,
+        index: int,
+    ):
+        self.transactions = transactions
+        self.self_parent_index = self_parent_index
+        self.other_parent_creator_id = other_parent_creator_id
+        self.other_parent_index = other_parent_index
+        self.creator_id = creator_id
+        self.timestamp = timestamp
+        self.index = index
+
+
+class WireEvent(GoStruct):
+    go_fields = (
+        ("Body", "body"),
+        ("R", "r"),
+        ("S", "s"),
+    )
+
+    def __init__(self, body: WireBody, r: int, s: int):
+        self.body = body
+        self.r = BigInt(r)
+        self.s = BigInt(s)
+
+    def to_dict(self) -> dict:
+        return {
+            "Body": {
+                "Transactions": (
+                    None
+                    if self.body.transactions is None
+                    else [t for t in self.body.transactions]
+                ),
+                "SelfParentIndex": self.body.self_parent_index,
+                "OtherParentCreatorID": self.body.other_parent_creator_id,
+                "OtherParentIndex": self.body.other_parent_index,
+                "CreatorID": self.body.creator_id,
+                "Timestamp": self.body.timestamp.rfc3339nano(),
+                "Index": self.body.index,
+            },
+            "R": int(self.r),
+            "S": int(self.s),
+        }
+
+    @classmethod
+    def from_json_obj(cls, obj: dict) -> "WireEvent":
+        body = obj["Body"]
+        txs = body.get("Transactions")
+        if txs is not None:
+            txs = [t if isinstance(t, bytes) else base64.b64decode(t) for t in txs]
+        return cls(
+            body=WireBody(
+                transactions=txs,
+                self_parent_index=body["SelfParentIndex"],
+                other_parent_creator_id=body["OtherParentCreatorID"],
+                other_parent_index=body["OtherParentIndex"],
+                creator_id=body["CreatorID"],
+                timestamp=Timestamp.parse(body["Timestamp"]),
+                index=body["Index"],
+            ),
+            r=obj["R"],
+            s=obj["S"],
+        )
+
+
+def by_topological_order(events: List[Event]) -> List[Event]:
+    """Sort key mirror of reference event.go:241-247."""
+    return sorted(events, key=lambda e: e.topological_index)
+
+
+def by_timestamp(events: List[Event]) -> List[Event]:
+    """Sort mirror of reference event.go:227-237. Go uses unstable
+    sort.Sort; keys here are total enough for our uses (median only
+    reads the timestamp value, which ties share)."""
+    return sorted(events, key=lambda e: e.body.timestamp.ns)

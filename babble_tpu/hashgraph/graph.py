@@ -1,0 +1,721 @@
+"""The hashgraph consensus engine (incremental host implementation).
+
+Behavioral mirror of the reference engine (hashgraph/hashgraph.go), kept
+exactly semantics-equivalent so it can serve as (a) the per-node engine
+in the live gossip runtime, and (b) the parity oracle for the batched
+TPU engine in babble_tpu.ops.
+
+Key semantics preserved (with reference anchors):
+- ancestor(x,y) via per-participant coordinate vectors (hashgraph.go:82-101)
+- stronglySee = lane-wise compare-and-count >= 2n/3+1 (hashgraph.go:179-198)
+- parentRound/Root fallbacks incl. Others shortcut (hashgraph.go:211-262)
+- witness / roundInc / round (hashgraph.go:265-339)
+- insert pipeline: verify, parent checks, topo index, wire info,
+  coordinate init, first-descendant back-propagation (hashgraph.go:356-530)
+- DivideRounds / DecideFame (incl. coin rounds) / DecideRoundReceived /
+  FindOrder with the ConsensusSorter quirk: the sorter's round map is
+  never populated, so the PRN is always 0 and the final tiebreak is a raw
+  big-int compare of S (hashgraph.go:616-858, consensus_sorter.go:21-52)
+- GetFrame / Reset / Bootstrap (hashgraph.go:879-1037)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..common import LRU, StoreError, StoreErrType, is_store_err
+from ..gojson import Timestamp, ZERO_TIME
+from .block import Block
+from .event import Event, EventBody, EventCoordinates, WireEvent
+from .frame import Frame
+from .root import Root
+from .round_info import RoundInfo
+from .store import Store
+
+MAX_INT32 = 2**31 - 1
+
+
+class InsertError(Exception):
+    pass
+
+
+class ParentRoundInfo:
+    __slots__ = ("round", "is_root")
+
+    def __init__(self, round: int = -1, is_root: bool = False):
+        self.round = round
+        self.is_root = is_root
+
+
+def middle_bit(ehex: str) -> bool:
+    """Coin-flip bit: middle byte of the event hash — hashgraph.go:1039-1048."""
+    data = bytes.fromhex(ehex[2:])
+    if len(data) > 0 and data[len(data) // 2] == 0:
+        return False
+    return True
+
+
+class Hashgraph:
+    def __init__(
+        self,
+        participants: Dict[str, int],
+        store: Store,
+        commit_callback: Optional[Callable[[Block], None]] = None,
+    ):
+        self.participants = participants
+        self.reverse_participants = {pid: pk for pk, pid in participants.items()}
+        self.store = store
+        self.commit_callback = commit_callback
+
+        self.undetermined_events: List[str] = []
+        self.undecided_rounds: List[int] = [0]
+        self.last_consensus_round: Optional[int] = None
+        self.last_commited_round_events = 0
+        self.consensus_transactions = 0
+        self.pending_loaded_events = 0
+        self.topological_index = 0
+        self.super_majority = 2 * len(participants) // 3 + 1
+
+        cache_size = store.cache_size()
+        self._ancestor_cache = LRU(cache_size)
+        self._self_ancestor_cache = LRU(cache_size)
+        self._oldest_self_ancestor_cache = LRU(cache_size)
+        self._strongly_see_cache = LRU(cache_size)
+        self._parent_round_cache = LRU(cache_size)
+        self._round_cache = LRU(cache_size)
+
+    # -- reachability ------------------------------------------------------
+
+    def ancestor(self, x: str, y: str) -> bool:
+        """True if y is an ancestor of x."""
+        c, ok = self._ancestor_cache.get((x, y))
+        if ok:
+            return c
+        a = self._ancestor(x, y)
+        self._ancestor_cache.add((x, y), a)
+        return a
+
+    def _ancestor(self, x: str, y: str) -> bool:
+        if x == y:
+            return True
+        try:
+            ex = self.store.get_event(x)
+            ey = self.store.get_event(y)
+        except StoreError:
+            return False
+        ey_creator = self.participants[ey.creator()]
+        return ex.last_ancestors[ey_creator].index >= ey.index()
+
+    def self_ancestor(self, x: str, y: str) -> bool:
+        c, ok = self._self_ancestor_cache.get((x, y))
+        if ok:
+            return c
+        a = self._self_ancestor(x, y)
+        self._self_ancestor_cache.add((x, y), a)
+        return a
+
+    def _self_ancestor(self, x: str, y: str) -> bool:
+        if x == y:
+            return True
+        try:
+            ex = self.store.get_event(x)
+            ey = self.store.get_event(y)
+        except StoreError:
+            return False
+        return (
+            self.participants[ex.creator()] == self.participants[ey.creator()]
+            and ex.index() >= ey.index()
+        )
+
+    def see(self, x: str, y: str) -> bool:
+        # Fork detection is unnecessary: InsertEvent forbids two events by
+        # the same creator at the same height (hashgraph.go:133-138).
+        return self.ancestor(x, y)
+
+    def oldest_self_ancestor_to_see(self, x: str, y: str) -> str:
+        c, ok = self._oldest_self_ancestor_cache.get((x, y))
+        if ok:
+            return c
+        res = self._oldest_self_ancestor_to_see(x, y)
+        self._oldest_self_ancestor_cache.add((x, y), res)
+        return res
+
+    def _oldest_self_ancestor_to_see(self, x: str, y: str) -> str:
+        try:
+            ex = self.store.get_event(x)
+            ey = self.store.get_event(y)
+        except StoreError:
+            return ""
+        a = ey.first_descendants[self.participants[ex.creator()]]
+        if a.index <= ex.index():
+            return a.hash
+        return ""
+
+    def strongly_see(self, x: str, y: str) -> bool:
+        c, ok = self._strongly_see_cache.get((x, y))
+        if ok:
+            return c
+        ss = self._strongly_see(x, y)
+        self._strongly_see_cache.add((x, y), ss)
+        return ss
+
+    def _strongly_see(self, x: str, y: str) -> bool:
+        try:
+            ex = self.store.get_event(x)
+            ey = self.store.get_event(y)
+        except StoreError:
+            return False
+        c = sum(
+            1
+            for exl, eyf in zip(ex.last_ancestors, ey.first_descendants)
+            if exl.index >= eyf.index
+        )
+        return c >= self.super_majority
+
+    # -- rounds ------------------------------------------------------------
+
+    def parent_round(self, x: str) -> ParentRoundInfo:
+        c, ok = self._parent_round_cache.get(x)
+        if ok:
+            return c
+        pr = self._parent_round(x)
+        self._parent_round_cache.add(x, pr)
+        return pr
+
+    def _parent_round(self, x: str) -> ParentRoundInfo:
+        res = ParentRoundInfo()
+        try:
+            ex = self.store.get_event(x)
+            root = self.store.get_root(ex.creator())
+        except StoreError:
+            return res
+
+        # Self-parent round: from the Root if x is the creator's first event.
+        if ex.self_parent() == root.x:
+            sp_round, sp_root = root.round, True
+        else:
+            sp_round, sp_root = self.round(ex.self_parent()), False
+
+        op_round, op_root = -1, False
+        other_parent = ex.other_parent()
+        op_known = True
+        try:
+            self.store.get_event(other_parent)
+        except StoreError:
+            op_known = False
+        if op_known:
+            op_round = self.round(other_parent)
+        elif other_parent == root.y:
+            op_round, op_root = root.round, True
+        elif root.others.get(x) == other_parent:
+            # Other-parent referenced in Root.Others: use the Root's round
+            # (an upper bound is acceptable for the max — hashgraph.go:245-253).
+            op_round = root.round
+
+        res.round, res.is_root = sp_round, sp_root
+        if sp_round < op_round:
+            res.round, res.is_root = op_round, op_root
+        return res
+
+    def witness(self, x: str) -> bool:
+        try:
+            ex = self.store.get_event(x)
+            root = self.store.get_root(ex.creator())
+        except StoreError:
+            return False
+        if ex.self_parent() == root.x and ex.other_parent() == root.y:
+            return True
+        return self.round(x) > self.round(ex.self_parent())
+
+    def round_inc(self, x: str) -> bool:
+        parent_round = self.parent_round(x)
+        if parent_round.is_root:
+            # x sits right on top of a Root.
+            return True
+        c = sum(
+            1
+            for w in self.store.round_witnesses(parent_round.round)
+            if self.strongly_see(x, w)
+        )
+        return c >= self.super_majority
+
+    def round_received(self, x: str) -> int:
+        try:
+            ex = self.store.get_event(x)
+        except StoreError:
+            return -1
+        return ex.round_received if ex.round_received is not None else -1
+
+    def round(self, x: str) -> int:
+        c, ok = self._round_cache.get(x)
+        if ok:
+            return c
+        r = self._round(x)
+        self._round_cache.add(x, r)
+        return r
+
+    def _round(self, x: str) -> int:
+        round_ = self.parent_round(x).round
+        if self.round_inc(x):
+            round_ += 1
+        return round_
+
+    def round_diff(self, x: str, y: str) -> int:
+        x_round = self.round(x)
+        if x_round < 0:
+            raise ValueError(f"event {x} has negative round")
+        y_round = self.round(y)
+        if y_round < 0:
+            raise ValueError(f"event {y} has negative round")
+        return x_round - y_round
+
+    # -- insertion ---------------------------------------------------------
+
+    def insert_event(self, event: Event, set_wire_info: bool) -> None:
+        if not event.verify():
+            raise InsertError("Invalid signature")
+
+        try:
+            self._check_self_parent(event)
+        except Exception as e:
+            raise InsertError(f"CheckSelfParent: {e}") from e
+        try:
+            self._check_other_parent(event)
+        except Exception as e:
+            raise InsertError(f"CheckOtherParent: {e}") from e
+
+        event.topological_index = self.topological_index
+        self.topological_index += 1
+
+        if set_wire_info:
+            self._set_wire_info(event)
+
+        self._init_event_coordinates(event)
+        self.store.set_event(event)
+        self._update_ancestor_first_descendant(event)
+
+        self.undetermined_events.append(event.hex())
+        if event.is_loaded():
+            self.pending_loaded_events += 1
+
+    def _check_self_parent(self, event: Event) -> None:
+        """Self-parent must be the creator's last known event — forbids forks
+        at insert time (hashgraph.go:404-420)."""
+        creator_last_known, _ = self.store.last_from(event.creator())
+        if event.self_parent() != creator_last_known:
+            raise InsertError("Self-parent not last known event by creator")
+
+    def _check_other_parent(self, event: Event) -> None:
+        other_parent = event.other_parent()
+        if other_parent == "":
+            return
+        try:
+            self.store.get_event(other_parent)
+            return
+        except StoreError:
+            pass
+        # Might still be referenced in the creator's Root.
+        root = self.store.get_root(event.creator())
+        if root.x == event.self_parent() and root.y == other_parent:
+            return
+        if root.others.get(event.hex()) == other_parent:
+            return
+        raise InsertError("Other-parent not known")
+
+    def _init_event_coordinates(self, event: Event) -> None:
+        members = len(self.participants)
+        event.first_descendants = [
+            EventCoordinates(index=MAX_INT32) for _ in range(members)
+        ]
+
+        sp, op = None, None
+        try:
+            sp = self.store.get_event(event.self_parent())
+        except StoreError:
+            pass
+        try:
+            op = self.store.get_event(event.other_parent())
+        except StoreError:
+            pass
+
+        if sp is None and op is None:
+            event.last_ancestors = [EventCoordinates(index=-1) for _ in range(members)]
+        elif sp is None:
+            event.last_ancestors = [c.copy() for c in op.last_ancestors]
+        elif op is None:
+            event.last_ancestors = [c.copy() for c in sp.last_ancestors]
+        else:
+            event.last_ancestors = [c.copy() for c in sp.last_ancestors]
+            for i in range(members):
+                if event.last_ancestors[i].index < op.last_ancestors[i].index:
+                    event.last_ancestors[i].index = op.last_ancestors[i].index
+                    event.last_ancestors[i].hash = op.last_ancestors[i].hash
+
+        index = event.index()
+        creator_id = self.participants.get(event.creator())
+        if creator_id is None:
+            raise InsertError("Could not find fake creator id")
+        ehex = event.hex()
+        event.first_descendants[creator_id] = EventCoordinates(index=index, hash=ehex)
+        event.last_ancestors[creator_id] = EventCoordinates(index=index, hash=ehex)
+
+    def _update_ancestor_first_descendant(self, event: Event) -> None:
+        """Back-propagate: each last-ancestor chain gets its first descendant
+        by this creator set to the new event (hashgraph.go:502-530)."""
+        creator_id = self.participants.get(event.creator())
+        if creator_id is None:
+            raise InsertError(f"Could not find creator fake id ({event.creator()})")
+        index = event.index()
+        ehex = event.hex()
+        for coord in event.last_ancestors:
+            ah = coord.hash
+            while ah != "":
+                try:
+                    a = self.store.get_event(ah)
+                except StoreError:
+                    break
+                if a.first_descendants[creator_id].index == MAX_INT32:
+                    a.first_descendants[creator_id] = EventCoordinates(
+                        index=index, hash=ehex
+                    )
+                    self.store.set_event(a)
+                    ah = a.self_parent()
+                else:
+                    break
+
+    def _set_wire_info(self, event: Event) -> None:
+        self_parent_index = -1
+        other_parent_creator_id = -1
+        other_parent_index = -1
+
+        lf, is_root = self.store.last_from(event.creator())
+        if is_root and lf == event.self_parent():
+            root = self.store.get_root(event.creator())
+            self_parent_index = root.index
+        else:
+            self_parent = self.store.get_event(event.self_parent())
+            self_parent_index = self_parent.index()
+
+        if event.other_parent() != "":
+            other_parent = self.store.get_event(event.other_parent())
+            other_parent_creator_id = self.participants[other_parent.creator()]
+            other_parent_index = other_parent.index()
+
+        event.set_wire_info(
+            self_parent_index,
+            other_parent_creator_id,
+            other_parent_index,
+            self.participants[event.creator()],
+        )
+
+    def read_wire_info(self, wevent: WireEvent) -> Event:
+        """Resolve a compact wire event's int coordinates back to parent
+        hashes via the store (hashgraph.go:569-614)."""
+        self_parent = ""
+        other_parent = ""
+        creator = self.reverse_participants[wevent.body.creator_id]
+        creator_bytes = bytes.fromhex(creator[2:])
+
+        if wevent.body.self_parent_index >= 0:
+            self_parent = self.store.participant_event(
+                creator, wevent.body.self_parent_index
+            )
+        if wevent.body.other_parent_index >= 0:
+            other_parent_creator = self.reverse_participants[
+                wevent.body.other_parent_creator_id
+            ]
+            other_parent = self.store.participant_event(
+                other_parent_creator, wevent.body.other_parent_index
+            )
+
+        body = EventBody(
+            transactions=wevent.body.transactions,
+            parents=[self_parent, other_parent],
+            creator=creator_bytes,
+            timestamp=wevent.body.timestamp,
+            index=wevent.body.index,
+        )
+        body.self_parent_index = wevent.body.self_parent_index
+        body.other_parent_creator_id = wevent.body.other_parent_creator_id
+        body.other_parent_index = wevent.body.other_parent_index
+        body.creator_id = wevent.body.creator_id
+
+        return Event(body, r=wevent.r, s=wevent.s)
+
+    # -- consensus pipeline ------------------------------------------------
+
+    def divide_rounds(self) -> None:
+        for ehex in self.undetermined_events:
+            round_number = self.round(ehex)
+            witness = self.witness(ehex)
+            try:
+                round_info = self.store.get_round(round_number)
+            except StoreError as err:
+                if not is_store_err(err, StoreErrType.KEY_NOT_FOUND):
+                    raise
+                round_info = RoundInfo()
+            if not round_info.queued:
+                self.undecided_rounds.append(round_number)
+                round_info.queued = True
+            round_info.add_event(ehex, witness)
+            self.store.set_round(round_number, round_info)
+
+    def decide_fame(self) -> None:
+        votes: Dict[str, Dict[str, bool]] = {}
+
+        def set_vote(y: str, x: str, v: bool) -> None:
+            votes.setdefault(y, {})[x] = v
+
+        decided_rounds: Dict[int, int] = {}
+        try:
+            for pos, i in enumerate(self.undecided_rounds):
+                round_info = self.store.get_round(i)
+                for x in round_info.witnesses():
+                    if round_info.is_decided(x):
+                        continue
+                    decided_x = False
+                    for j in range(i + 1, self.store.last_round() + 1):
+                        if decided_x:
+                            break
+                        for y in self.store.round_witnesses(j):
+                            diff = j - i
+                            if diff == 1:
+                                set_vote(y, x, self.see(y, x))
+                            else:
+                                ss_witnesses = [
+                                    w
+                                    for w in self.store.round_witnesses(j - 1)
+                                    if self.strongly_see(y, w)
+                                ]
+                                yays = sum(
+                                    1 for w in ss_witnesses if votes.get(w, {}).get(x, False)
+                                )
+                                nays = len(ss_witnesses) - yays
+                                v, t = (True, yays) if yays >= nays else (False, nays)
+
+                                if diff % len(self.participants) > 0:
+                                    # normal round
+                                    if t >= self.super_majority:
+                                        round_info.set_fame(x, v)
+                                        set_vote(y, x, v)
+                                        decided_x = True
+                                        break  # out of y loop; j loop breaks above
+                                    set_vote(y, x, v)
+                                else:
+                                    # coin round
+                                    if t >= self.super_majority:
+                                        set_vote(y, x, v)
+                                    else:
+                                        set_vote(y, x, middle_bit(y))
+
+                if round_info.witnesses_decided():
+                    decided_rounds[i] = pos
+                    if (
+                        self.last_consensus_round is None
+                        or i > self.last_consensus_round
+                    ):
+                        self._set_last_consensus_round(i)
+
+                self.store.set_round(i, round_info)
+        finally:
+            self._update_undecided_rounds(decided_rounds)
+
+    def _update_undecided_rounds(self, decided_rounds: Dict[int, int]) -> None:
+        self.undecided_rounds = [
+            ur for ur in self.undecided_rounds if ur not in decided_rounds
+        ]
+
+    def _set_last_consensus_round(self, i: int) -> None:
+        self.last_consensus_round = i
+        self.last_commited_round_events = self.store.round_events(i - 1)
+
+    def decide_round_received(self) -> None:
+        for x in self.undetermined_events:
+            r = self.round(x)
+            for i in range(r + 1, self.store.last_round() + 1):
+                try:
+                    tr = self.store.get_round(i)
+                except StoreError as err:
+                    if not is_store_err(err, StoreErrType.KEY_NOT_FOUND):
+                        raise
+                    tr = RoundInfo()
+
+                # Skip until the round is fully decided and all earlier
+                # rounds are too (hashgraph.go:762-764).
+                if not (tr.witnesses_decided() and self.undecided_rounds[0] > i):
+                    continue
+
+                fws = tr.famous_witnesses()
+                s = [w for w in fws if self.see(w, x)]
+                if len(s) > len(fws) // 2:
+                    ex = self.store.get_event(x)
+                    ex.set_round_received(i)
+                    t = [self.oldest_self_ancestor_to_see(a, x) for a in s]
+                    ex.consensus_timestamp = self.median_timestamp(t)
+                    self.store.set_event(ex)
+                    break
+
+    def find_order(self) -> None:
+        self.decide_round_received()
+
+        new_consensus_events: List[Event] = []
+        new_undetermined: List[str] = []
+        for x in self.undetermined_events:
+            ex = self.store.get_event(x)
+            if ex.round_received is not None:
+                new_consensus_events.append(ex)
+            else:
+                new_undetermined.append(x)
+        self.undetermined_events = new_undetermined
+
+        # ConsensusSorter quirk (consensus_sorter.go:44-52): its round map is
+        # never populated, so PseudoRandomNumber is always 0 and the final
+        # tiebreak is a raw big-int compare of S.
+        new_consensus_events.sort(
+            key=lambda e: (
+                e.round_received if e.round_received is not None else -1,
+                e.consensus_timestamp.ns,
+                int(e.s),
+            )
+        )
+
+        block_map: Dict[int, Block] = {}
+        block_order: List[int] = []
+        for e in new_consensus_events:
+            self.store.add_consensus_event(e.hex())
+            self.consensus_transactions += len(e.transactions() or [])
+            if e.is_loaded():
+                self.pending_loaded_events -= 1
+
+            b = block_map.get(e.round_received)
+            etxs = e.transactions()
+            if b is None:
+                # Preserve nil-vs-empty: Go NewBlock keeps a nil slice nil,
+                # which marshals as null and affects the block hash
+                # (block.go:19-33).
+                b = Block(e.round_received, None if etxs is None else list(etxs))
+                block_order.append(e.round_received)
+                block_map[e.round_received] = b
+            elif etxs:
+                # Go append(nil, elems...) allocates; append(x) with no
+                # elems leaves nil untouched.
+                if b.transactions is None:
+                    b.transactions = list(etxs)
+                else:
+                    b.transactions.extend(etxs)
+
+        for rr in block_order:
+            block = block_map[rr]
+            self.store.set_block(block)
+            if self.commit_callback is not None and block.transactions:
+                self.commit_callback(block)
+
+    def median_timestamp(self, event_hashes: List[str]) -> Timestamp:
+        timestamps = []
+        for x in event_hashes:
+            try:
+                ex = self.store.get_event(x)
+                timestamps.append(ex.body.timestamp)
+            except StoreError:
+                # Go ignores the error and appends a zero event
+                # (hashgraph.go:860-868).
+                timestamps.append(ZERO_TIME)
+        timestamps.sort(key=lambda t: t.ns)
+        return timestamps[len(timestamps) // 2]
+
+    def run_consensus(self) -> None:
+        self.divide_rounds()
+        self.decide_fame()
+        self.find_order()
+
+    # -- queries -----------------------------------------------------------
+
+    def consensus_events(self) -> List[str]:
+        return self.store.consensus_events()
+
+    def known(self) -> Dict[int, int]:
+        return self.store.known()
+
+    # -- checkpoint / recovery --------------------------------------------
+
+    def reset(self, roots: Dict[str, Root]) -> None:
+        self.store.reset(roots)
+        self.undetermined_events = []
+        self.undecided_rounds = []
+        self.pending_loaded_events = 0
+        self.topological_index = 0
+
+        cache_size = self.store.cache_size()
+        self._ancestor_cache = LRU(cache_size)
+        self._self_ancestor_cache = LRU(cache_size)
+        self._oldest_self_ancestor_cache = LRU(cache_size)
+        self._strongly_see_cache = LRU(cache_size)
+        self._parent_round_cache = LRU(cache_size)
+        self._round_cache = LRU(cache_size)
+
+    def get_frame(self) -> Frame:
+        last_consensus_round_index = (
+            self.last_consensus_round if self.last_consensus_round is not None else 0
+        )
+        last_consensus_round = self.store.get_round(last_consensus_round_index)
+        witness_hashes = last_consensus_round.witnesses()
+
+        events: List[Event] = []
+        roots: Dict[str, Root] = {}
+        for wh in witness_hashes:
+            w = self.store.get_event(wh)
+            events.append(w)
+            roots[w.creator()] = Root(
+                x=w.self_parent(),
+                y=w.other_parent(),
+                index=w.index() - 1,
+                round=self.round(w.self_parent()),
+                others={},
+            )
+            for e in self.store.participant_events(w.creator(), w.index()):
+                events.append(self.store.get_event(e))
+
+        # Participants without a witness in the last consensus round use
+        # their last known event (hashgraph.go:942-973).
+        for p in self.participants:
+            if p not in roots:
+                last, is_root = self.store.last_from(p)
+                if is_root:
+                    root = self.store.get_root(p)
+                else:
+                    ev = self.store.get_event(last)
+                    events.append(ev)
+                    root = Root(
+                        x=ev.self_parent(),
+                        y=ev.other_parent(),
+                        index=ev.index() - 1,
+                        round=self.round(ev.self_parent()),
+                        others={},
+                    )
+                roots[p] = root
+
+        events.sort(key=lambda e: e.topological_index)
+
+        # Events whose other-parents fall outside the Frame get them
+        # recorded in the creator's Root.Others (hashgraph.go:977-994).
+        treated: Dict[str, bool] = {}
+        for ev in events:
+            treated[ev.hex()] = True
+            other_parent = ev.other_parent()
+            if other_parent != "" and not treated.get(other_parent, False):
+                if ev.self_parent() != roots[ev.creator()].x:
+                    roots[ev.creator()].others[ev.hex()] = other_parent
+
+        return Frame(roots=roots, events=events)
+
+    def bootstrap(self) -> None:
+        """Replay a persistent store's topological event log and recompute
+        consensus to the tip (hashgraph.go:1008-1037)."""
+        db_events = getattr(self.store, "db_topological_events", None)
+        if db_events is None:
+            return
+        for e in db_events():
+            self.insert_event(e, True)
+        self.divide_rounds()
+        self.decide_fame()
+        self.find_order()

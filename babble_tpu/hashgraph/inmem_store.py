@@ -1,0 +1,137 @@
+"""Volatile LRU-backed store.
+
+Reference: hashgraph/inmem_store.go. Event/round/block caches are LRUs of
+the configured size (small caches can evict live state — callers size
+them above the working set, as the reference tests do); per-participant
+indexes are rolling windows yielding TooLate when aged out.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..common import LRU, RollingIndex, StoreError, StoreErrType, is_store_err
+from .block import Block
+from .event import Event
+from .participant_events import ParticipantEventsCache
+from .root import Root, new_base_root
+from .round_info import RoundInfo
+
+
+class InmemStore:
+    def __init__(self, participants: Dict[str, int], cache_size: int):
+        self._cache_size = cache_size
+        self._participants = participants
+        self.event_cache = LRU(cache_size)
+        self.round_cache = LRU(cache_size)
+        self.block_cache = LRU(cache_size)
+        self.consensus_cache = RollingIndex(cache_size)
+        self.tot_consensus_events = 0
+        self.participant_events_cache = ParticipantEventsCache(cache_size, participants)
+        self.roots: Dict[str, Root] = {pk: new_base_root() for pk in participants}
+        self._last_round = -1
+
+    def cache_size(self) -> int:
+        return self._cache_size
+
+    def participants(self) -> Dict[str, int]:
+        return self._participants
+
+    def get_event(self, key: str) -> Event:
+        res, ok = self.event_cache.get(key)
+        if not ok:
+            raise StoreError(StoreErrType.KEY_NOT_FOUND, key)
+        return res
+
+    def set_event(self, event: Event) -> None:
+        key = event.hex()
+        known = self.event_cache.contains(key)
+        if not known:
+            self.participant_events_cache.add(event.creator(), key, event.index())
+        self.event_cache.add(key, event)
+
+    def participant_events(self, participant: str, skip: int) -> List[str]:
+        return self.participant_events_cache.get(participant, skip)
+
+    def participant_event(self, participant: str, index: int) -> str:
+        return self.participant_events_cache.get_item(participant, index)
+
+    def last_from(self, participant: str) -> Tuple[str, bool]:
+        last = self.participant_events_cache.get_last(participant)
+        is_root = False
+        if last == "":
+            root = self.roots.get(participant)
+            if root is not None:
+                last = root.x
+                is_root = True
+            else:
+                raise StoreError(StoreErrType.NO_ROOT, participant)
+        return last, is_root
+
+    def known(self) -> Dict[int, int]:
+        return self.participant_events_cache.known()
+
+    def consensus_events(self) -> List[str]:
+        window, _ = self.consensus_cache.get_last_window()
+        return list(window)
+
+    def consensus_events_count(self) -> int:
+        return self.tot_consensus_events
+
+    def add_consensus_event(self, key: str) -> None:
+        self.consensus_cache.add(key, self.tot_consensus_events)
+        self.tot_consensus_events += 1
+
+    def get_round(self, r: int) -> RoundInfo:
+        res, ok = self.round_cache.get(r)
+        if not ok:
+            raise StoreError(StoreErrType.KEY_NOT_FOUND, str(r))
+        return res
+
+    def set_round(self, r: int, round_info: RoundInfo) -> None:
+        self.round_cache.add(r, round_info)
+        if r > self._last_round:
+            self._last_round = r
+
+    def last_round(self) -> int:
+        return self._last_round
+
+    def round_witnesses(self, r: int) -> List[str]:
+        try:
+            round_info = self.get_round(r)
+        except StoreError:
+            return []
+        return round_info.witnesses()
+
+    def round_events(self, r: int) -> int:
+        try:
+            round_info = self.get_round(r)
+        except StoreError:
+            return 0
+        return len(round_info.events)
+
+    def get_root(self, participant: str) -> Root:
+        root = self.roots.get(participant)
+        if root is None:
+            raise StoreError(StoreErrType.KEY_NOT_FOUND, participant)
+        return root
+
+    def get_block(self, rr: int) -> Block:
+        res, ok = self.block_cache.get(rr)
+        if not ok:
+            raise StoreError(StoreErrType.KEY_NOT_FOUND, str(rr))
+        return res
+
+    def set_block(self, block: Block) -> None:
+        self.block_cache.add(block.round_received, block)
+
+    def reset(self, roots: Dict[str, Root]) -> None:
+        self.roots = roots
+        self.event_cache = LRU(self._cache_size)
+        self.round_cache = LRU(self._cache_size)
+        self.consensus_cache = RollingIndex(self._cache_size)
+        self.participant_events_cache.reset()
+        self._last_round = -1
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,81 @@
+"""Utility-layer tests — mirrors reference common/lru_test.go and
+common/rolling_index_test.go (incl. TooLate/skip semantics)."""
+
+import pytest
+
+from babble_tpu.common import LRU, RollingIndex, StoreError, StoreErrType, is_store_err
+
+
+def test_lru_basic():
+    evicted = []
+    lru = LRU(2, on_evict=lambda k, v: evicted.append(k))
+    assert not lru.add("a", 1)
+    assert not lru.add("b", 2)
+    v, ok = lru.get("a")
+    assert ok and v == 1
+    # "b" is now LRU; adding "c" evicts it
+    assert lru.add("c", 3)
+    assert evicted == ["b"]
+    _, ok = lru.get("b")
+    assert not ok
+    assert len(lru) == 2
+    assert lru.keys() == ["a", "c"]
+
+
+def test_lru_update_refreshes():
+    lru = LRU(2)
+    lru.add("a", 1)
+    lru.add("b", 2)
+    lru.add("a", 10)  # refresh
+    lru.add("c", 3)  # evicts b
+    assert lru.contains("a") and lru.contains("c") and not lru.contains("b")
+    v, _ = lru.get("a")
+    assert v == 10
+
+
+def test_rolling_index_window():
+    size = 10
+    ri = RollingIndex(size)
+    items = [f"item{i}" for i in range(9)]
+    for i, it in enumerate(items):
+        ri.add(it, i)
+    cached, last = ri.get_last_window()
+    assert last == 8
+    assert list(cached) == items
+
+    # get with skip
+    assert ri.get(4) == items[5:]
+    assert ri.get(8) == []
+    assert ri.get(100) == []
+
+
+def test_rolling_index_roll_and_too_late():
+    size = 2
+    ri = RollingIndex(size)
+    for i in range(2 * size + 1):  # forces one roll
+        ri.add(i, i)
+    # window now holds indexes 2..4
+    with pytest.raises(StoreError) as ei:
+        ri.get(0)
+    assert is_store_err(ei.value, StoreErrType.TOO_LATE)
+    assert ri.get(1) == [2, 3, 4]
+
+    with pytest.raises(StoreError) as ei:
+        ri.get_item(1)
+    assert is_store_err(ei.value, StoreErrType.TOO_LATE)
+    assert ri.get_item(3) == 3
+    with pytest.raises(StoreError) as ei:
+        ri.get_item(10)
+    assert is_store_err(ei.value, StoreErrType.KEY_NOT_FOUND)
+
+
+def test_rolling_index_add_errors():
+    ri = RollingIndex(5)
+    ri.add("a", 0)
+    with pytest.raises(StoreError) as ei:
+        ri.add("dup", 0)
+    assert is_store_err(ei.value, StoreErrType.PASSED_INDEX)
+    with pytest.raises(StoreError) as ei:
+        ri.add("skip", 2)
+    assert is_store_err(ei.value, StoreErrType.SKIPPED_INDEX)
+    ri.add("b", 1)

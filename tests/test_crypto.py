@@ -1,0 +1,49 @@
+"""Crypto layer — mirrors reference crypto/crypto_test.go (TestPem) plus
+sign/verify round trips."""
+
+import os
+
+from babble_tpu import crypto
+
+
+def test_sign_verify():
+    key = crypto.generate_key()
+    digest = crypto.sha256(b"hello")
+    r, s = crypto.sign(key, digest)
+    pub = crypto.pub_key_from_bytes(crypto.pub_key_bytes(key))
+    assert crypto.verify(pub, digest, r, s)
+    assert not crypto.verify(pub, crypto.sha256(b"tampered"), r, s)
+
+
+def test_pub_key_roundtrip():
+    key = crypto.key_from_seed(42)
+    raw = crypto.pub_key_bytes(key)
+    assert len(raw) == 65 and raw[0] == 0x04  # uncompressed point
+    pub = crypto.pub_key_from_bytes(raw)
+    from cryptography.hazmat.primitives.serialization import Encoding, PublicFormat
+
+    assert pub.public_bytes(Encoding.X962, PublicFormat.UncompressedPoint) == raw
+
+
+def test_deterministic_seed_keys():
+    k1 = crypto.key_from_seed(7)
+    k2 = crypto.key_from_seed(7)
+    assert crypto.pub_key_bytes(k1) == crypto.pub_key_bytes(k2)
+    assert crypto.pub_key_bytes(k1) != crypto.pub_key_bytes(crypto.key_from_seed(8))
+
+
+def test_pem_roundtrip(tmp_path):
+    pem = crypto.PemKey(str(tmp_path))
+    key = crypto.generate_key()
+    pem.write_key(key)
+    key2 = pem.read_key()
+    assert crypto.pub_key_bytes(key) == crypto.pub_key_bytes(key2)
+    with open(os.path.join(str(tmp_path), "priv_key.pem")) as f:
+        assert "EC PRIVATE KEY" in f.read()
+
+
+def test_generate_pem_key():
+    dump = crypto.generate_pem_key()
+    assert dump.public_key.startswith("0x")
+    assert len(dump.public_key) == 2 + 130  # 65 bytes hex
+    assert "EC PRIVATE KEY" in dump.private_key

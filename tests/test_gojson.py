@@ -1,0 +1,75 @@
+"""Go-JSON encoding vectors. Expected strings derived from the behavior of
+Go's encoding/json (json.Encoder with default HTML escaping), which is
+what the reference hashes to name events (reference
+hashgraph/event.go:30-54,155-188)."""
+
+from babble_tpu.gojson import (
+    BigInt,
+    GoStruct,
+    Timestamp,
+    ZERO_TIME,
+    marshal,
+)
+
+
+class Inner(GoStruct):
+    go_fields = (("A", "a"), ("B", "b"))
+
+    def __init__(self, a, b):
+        self.a = a
+        self.b = b
+
+
+def test_primitives():
+    assert marshal(Inner(1, "x")) == b'{"A":1,"B":"x"}\n'
+    assert marshal(Inner(None, [])) == b'{"A":null,"B":[]}\n'
+    assert marshal(Inner(True, False)) == b'{"A":true,"B":false}\n'
+
+
+def test_bytes_base64():
+    assert marshal(Inner(b"hi", None)) == b'{"A":"aGk=","B":null}\n'
+    # [][]byte{} -> [], nil -> null
+    assert marshal(Inner([b"a", b"bc"], None)) == b'{"A":["YQ==","YmM="],"B":null}\n'
+
+
+def test_html_escaping():
+    assert marshal(Inner("<&>", None)) == b'{"A":"\\u003c\\u0026\\u003e","B":null}\n'
+
+
+def test_bigint():
+    big = BigInt(2**300 + 7)
+    out = marshal(Inner(big, 0))
+    assert out == b'{"A":%d,"B":0}\n' % (2**300 + 7)
+
+
+def test_map_key_sorting():
+    # Go sorts map keys by string form: "10" < "2" lexicographically.
+    assert marshal(Inner({10: "x", 2: "y"}, None)) == b'{"A":{"10":"x","2":"y"},"B":null}\n'
+
+
+def test_timestamp_rfc3339nano():
+    # 2021-09-13T12:26:40.000000123Z
+    ts = Timestamp(1631536000 * 1_000_000_000 + 123)
+    assert ts.rfc3339nano() == "2021-09-13T12:26:40.000000123Z"
+    # trailing zeros trimmed
+    ts2 = Timestamp(1631536000 * 1_000_000_000 + 500_000_000)
+    assert ts2.rfc3339nano() == "2021-09-13T12:26:40.5Z"
+    # no fraction
+    ts3 = Timestamp(1631536000 * 1_000_000_000)
+    assert ts3.rfc3339nano() == "2021-09-13T12:26:40Z"
+
+
+def test_timestamp_zero_time():
+    assert ZERO_TIME.rfc3339nano() == "0001-01-01T00:00:00Z"
+
+
+def test_timestamp_parse_roundtrip():
+    for s in [
+        "2021-09-13T12:26:40.000000123Z",
+        "2021-09-13T12:26:40.5Z",
+        "2021-09-13T12:26:40Z",
+        "0001-01-01T00:00:00Z",
+    ]:
+        assert Timestamp.parse(s).rfc3339nano() == s
+    # offset form normalizes to Z
+    assert Timestamp.parse("2021-09-13T14:26:40+02:00").rfc3339nano() == "2021-09-13T12:26:40Z"

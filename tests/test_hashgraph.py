@@ -1,0 +1,433 @@
+"""Consensus-engine tests ported from the reference's algorithmic suite
+(reference hashgraph/hashgraph_test.go). These fixtures and assertions are
+the parity oracle for both engines (host + TPU)."""
+
+import pytest
+
+from babble_tpu.gojson import Timestamp
+from babble_tpu.hashgraph import Event, InmemStore, Root, Trilean
+from babble_tpu.hashgraph.graph import MAX_INT32, InsertError
+
+from fixtures import (
+    GraphBuilder,
+    Play,
+    build_basic_graph,
+    build_consensus_graph,
+    build_funky_graph,
+    build_round_graph,
+)
+
+
+# ---------------------------------------------------------------- ancestry
+
+
+def test_ancestor():
+    h, b = build_basic_graph()
+    i = b.index
+    # 1 generation
+    for x, y in [("e01", "e0"), ("e01", "e1"), ("s00", "e01"), ("s20", "e2"),
+                 ("e20", "s00"), ("e20", "s20"), ("e12", "e20"), ("e12", "s10")]:
+        assert h.ancestor(i[x], i[y]), f"{y} should be ancestor of {x}"
+    # 2 generations
+    for x, y in [("s00", "e0"), ("s00", "e1"), ("e20", "e01"), ("e20", "e2"),
+                 ("e12", "e1"), ("e12", "s20")]:
+        assert h.ancestor(i[x], i[y])
+    # 3 generations
+    for x, y in [("e20", "e0"), ("e20", "e1"), ("e20", "e2"), ("e12", "e01"),
+                 ("e12", "e0"), ("e12", "e1"), ("e12", "e2")]:
+        assert h.ancestor(i[x], i[y])
+    # false positives
+    assert not h.ancestor(i["e01"], i["e2"])
+    assert not h.ancestor(i["s00"], i["e2"])
+    assert not h.ancestor(i["e0"], "")
+    assert not h.ancestor(i["s00"], "")
+    assert not h.ancestor(i["e12"], "")
+
+
+def test_self_ancestor():
+    h, b = build_basic_graph()
+    i = b.index
+    assert h.self_ancestor(i["e01"], i["e0"])
+    assert h.self_ancestor(i["s00"], i["e01"])
+    assert not h.self_ancestor(i["e01"], i["e1"])
+    assert not h.self_ancestor(i["e12"], i["e20"])
+    assert not h.self_ancestor(i["s20"], "")
+    assert h.self_ancestor(i["e20"], i["e2"])
+    assert h.self_ancestor(i["e12"], i["e1"])
+    assert not h.self_ancestor(i["e20"], i["e0"])
+    assert not h.self_ancestor(i["e12"], i["e2"])
+    assert not h.self_ancestor(i["e20"], i["e01"])
+
+
+def test_see():
+    h, b = build_basic_graph()
+    i = b.index
+    for x, y in [("e01", "e0"), ("e01", "e1"), ("e20", "e0"), ("e20", "e01"),
+                 ("e12", "e01"), ("e12", "e0"), ("e12", "e1"), ("e12", "s20")]:
+        assert h.see(i[x], i[y]), f"{x} should see {y}"
+
+
+# ---------------------------------------------------------------- forks
+
+
+def test_fork_rejected():
+    """Reference hashgraph_test.go:299-363: a second index-0 event by the
+    same creator must be rejected, as must descendants referencing it."""
+    b = GraphBuilder(3)
+    h = b.make_hashgraph()
+
+    for i in range(3):
+        ev = b.add_initial(f"e{i}", i)
+        h.insert_event(ev, True)
+
+    # fork: node 2 creates another index-0 event with a different payload
+    node2 = b.nodes[2]
+    fork = Event.new([b"yo"], ["", ""], node2.pub, 0, timestamp=b._next_ts())
+    fork.sign(node2.key)
+    b.index["a"] = fork.hex()
+    with pytest.raises(InsertError):
+        h.insert_event(fork, True)
+
+    e01 = Event.new([], [b.index["e0"], b.index["a"]], b.nodes[0].pub, 1,
+                    timestamp=b._next_ts())
+    e01.sign(b.nodes[0].key)
+    b.index["e01"] = e01.hex()
+    with pytest.raises(InsertError):
+        h.insert_event(e01, True)
+
+    e20 = Event.new([], [b.index["e2"], b.index["e01"]], node2.pub, 1,
+                    timestamp=b._next_ts())
+    e20.sign(node2.key)
+    with pytest.raises(InsertError):
+        h.insert_event(e20, True)
+
+
+# ---------------------------------------------------------------- insert
+
+
+def test_insert_event_coordinates_and_wire():
+    h, b = build_round_graph()
+    i = b.index
+    participants = h.participants
+
+    e0 = h.store.get_event(i["e0"])
+    assert e0.body.self_parent_index == -1
+    assert e0.body.other_parent_creator_id == -1
+    assert e0.body.other_parent_index == -1
+    assert e0.body.creator_id == participants[e0.creator()]
+
+    assert [(c.index, c.hash) for c in e0.first_descendants] == [
+        (0, i["e0"]), (1, i["e10"]), (2, i["e21"])]
+    assert [c.index for c in e0.last_ancestors] == [0, -1, -1]
+    assert e0.last_ancestors[0].hash == i["e0"]
+
+    e21 = h.store.get_event(i["e21"])
+    e10 = h.store.get_event(i["e10"])
+    assert e21.body.self_parent_index == 1
+    assert e21.body.other_parent_creator_id == participants[e10.creator()]
+    assert e21.body.other_parent_index == 1
+    assert e21.body.creator_id == participants[e21.creator()]
+    assert [(c.index, c.hash) for c in e21.first_descendants] == [
+        (2, i["e02"]), (3, i["f1"]), (2, i["e21"])]
+    assert [(c.index, c.hash) for c in e21.last_ancestors] == [
+        (0, i["e0"]), (1, i["e10"]), (2, i["e21"])]
+
+    f1 = h.store.get_event(i["f1"])
+    assert f1.body.self_parent_index == 2
+    assert f1.body.other_parent_creator_id == participants[e0.creator()]
+    assert f1.body.other_parent_index == 2
+    assert f1.body.creator_id == participants[f1.creator()]
+    assert f1.first_descendants[0].index == MAX_INT32
+    assert (f1.first_descendants[1].index, f1.first_descendants[1].hash) == (3, i["f1"])
+    assert f1.first_descendants[2].index == MAX_INT32
+    assert [(c.index, c.hash) for c in f1.last_ancestors] == [
+        (2, i["e02"]), (3, i["f1"]), (2, i["e21"])]
+
+    assert h.pending_loaded_events == 4
+
+
+def test_read_wire_info_roundtrip():
+    h, b = build_round_graph()
+    for name, evh in b.index.items():
+        ev = h.store.get_event(evh)
+        wire = ev.to_wire()
+        ev2 = h.read_wire_info(wire)
+        assert ev2.body.parents == ev.body.parents, name
+        assert ev2.body.creator == ev.body.creator, name
+        assert ev2.body.index == ev.body.index, name
+        assert ev2.body.timestamp == ev.body.timestamp, name
+        assert (ev2.body.transactions or []) == (ev.body.transactions or []), name
+        assert (ev2.r, ev2.s) == (ev.r, ev.s), name
+        assert ev2.hex() == ev.hex(), name
+        assert ev2.verify(), name
+
+
+# ---------------------------------------------------------------- strongly see
+
+
+def test_strongly_see():
+    h, b = build_round_graph()
+    i = b.index
+    for x, y in [("e21", "e0"), ("e02", "e10"), ("e02", "e0"), ("e02", "e1"),
+                 ("f1", "e21"), ("f1", "e10"), ("f1", "e0"), ("f1", "e1"),
+                 ("f1", "e2"), ("s11", "e2")]:
+        assert h.strongly_see(i[x], i[y]), f"{x} should strongly see {y}"
+    for x, y in [("e10", "e0"), ("e21", "e1"), ("e21", "e2"), ("e02", "e2"),
+                 ("s11", "e02")]:
+        assert not h.strongly_see(i[x], i[y]), f"{x} should not strongly see {y}"
+
+
+# ---------------------------------------------------------------- rounds
+
+
+def _seed_round_info(h, b):
+    from babble_tpu.hashgraph import RoundInfo
+
+    r0 = RoundInfo()
+    for name in ["e0", "e1", "e2"]:
+        r0.add_event(b.index[name], witness=True)
+    h.store.set_round(0, r0)
+    r1 = RoundInfo()
+    r1.add_event(b.index["f1"], witness=True)
+    h.store.set_round(1, r1)
+
+
+def test_parent_round():
+    h, b = build_round_graph()
+    _seed_round_info(h, b)
+    i = b.index
+    assert h.parent_round(i["e0"]).round == -1
+    assert h.parent_round(i["e0"]).is_root
+    assert h.parent_round(i["e1"]).round == -1
+    assert h.parent_round(i["e1"]).is_root
+    assert h.parent_round(i["f1"]).round == 0
+    assert not h.parent_round(i["f1"]).is_root
+    assert h.parent_round(i["s11"]).round == 1
+    assert not h.parent_round(i["s11"]).is_root
+
+
+def test_witness():
+    h, b = build_round_graph()
+    _seed_round_info(h, b)
+    i = b.index
+    for w in ["e0", "e1", "e2", "f1"]:
+        assert h.witness(i[w]), f"{w} should be witness"
+    for w in ["e10", "e21", "e02"]:
+        assert not h.witness(i[w]), f"{w} should not be witness"
+
+
+def test_round_inc():
+    h, b = build_round_graph()
+    from babble_tpu.hashgraph import RoundInfo
+
+    r0 = RoundInfo()
+    for name in ["e0", "e1", "e2"]:
+        r0.add_event(b.index[name], witness=True)
+    h.store.set_round(0, r0)
+
+    assert h.round_inc(b.index["f1"])
+    assert not h.round_inc(b.index["e02"])
+
+
+def test_round():
+    h, b = build_round_graph()
+    from babble_tpu.hashgraph import RoundInfo
+
+    r0 = RoundInfo()
+    for name in ["e0", "e1", "e2"]:
+        r0.add_event(b.index[name], witness=True)
+    h.store.set_round(0, r0)
+
+    assert h.round(b.index["f1"]) == 1
+    assert h.round(b.index["e02"]) == 0
+    assert h.round_diff(b.index["f1"], b.index["e02"]) == 1
+    assert h.round_diff(b.index["e02"], b.index["f1"]) == -1
+    assert h.round_diff(b.index["e02"], b.index["e21"]) == 0
+
+
+def test_divide_rounds():
+    h, b = build_round_graph()
+    h.divide_rounds()
+    i = b.index
+
+    assert h.store.last_round() == 1
+    round0 = h.store.get_round(0)
+    assert len(round0.witnesses()) == 3
+    for w in ["e0", "e1", "e2"]:
+        assert i[w] in round0.witnesses()
+    round1 = h.store.get_round(1)
+    assert round1.witnesses() == [i["f1"]]
+
+
+# ---------------------------------------------------------------- consensus
+
+
+def test_decide_fame():
+    h, b = build_consensus_graph()
+    i = b.index
+    h.divide_rounds()
+    h.decide_fame()
+
+    assert h.round(i["g0"]) == 2
+    assert h.round(i["g1"]) == 2
+    assert h.round(i["g2"]) == 2
+
+    round0 = h.store.get_round(0)
+    for w in ["e0", "e1", "e2"]:
+        ev = round0.events[i[w]]
+        assert ev.witness and ev.famous == Trilean.TRUE, f"{w} should be famous"
+
+
+def test_oldest_self_ancestor_to_see():
+    h, b = build_consensus_graph()
+    i = b.index
+    assert h.oldest_self_ancestor_to_see(i["f0"], i["e1"]) == i["e02"]
+    assert h.oldest_self_ancestor_to_see(i["f1"], i["e0"]) == i["e10"]
+    assert h.oldest_self_ancestor_to_see(i["f1b"], i["e0"]) == i["e10"]
+    assert h.oldest_self_ancestor_to_see(i["g2"], i["f1"]) == i["f2"]
+    assert h.oldest_self_ancestor_to_see(i["e21"], i["e1"]) == i["e21"]
+    assert h.oldest_self_ancestor_to_see(i["e2"], i["e1"]) == ""
+
+
+def test_decide_round_received():
+    h, b = build_consensus_graph()
+    h.divide_rounds()
+    h.decide_fame()
+    h.decide_round_received()
+    for name, hash_ in b.index.items():
+        if name.startswith("e"):
+            e = h.store.get_event(hash_)
+            assert e.round_received == 1, f"{name} round received should be 1"
+
+
+def test_find_order():
+    h, b = build_consensus_graph()
+    h.divide_rounds()
+    h.decide_fame()
+    h.find_order()
+
+    consensus = h.consensus_events()
+    assert len(consensus) == 7
+    assert h.pending_loaded_events == 2
+    assert b.get_name(consensus[0]) == "e0"
+    assert b.get_name(consensus[6]) == "e02"
+
+
+def test_blocks():
+    h, _ = build_consensus_graph()
+    h.divide_rounds()
+    h.decide_fame()
+    h.find_order()
+
+    block0 = h.store.get_block(1)
+    assert block0.round_received == 1
+    assert block0.transactions == [b"e21"]
+
+
+def test_known():
+    h, _ = build_consensus_graph()
+    assert h.known() == {0: 8, 1: 7, 2: 7}
+
+
+# ---------------------------------------------------------------- reset/frames
+
+
+def test_reset():
+    h, b = build_consensus_graph()
+    i = b.index
+    evs = ["g1", "g0", "g2", "g10", "g21", "o02", "g02", "h1", "h0", "h2"]
+
+    backup = {}
+    for name in evs:
+        ev = h.store.get_event(i[name])
+        backup[name] = Event(ev.body, r=ev.r, s=ev.s)
+
+    roots = {
+        h.reverse_participants[0]: Root(
+            x=i["f02b"], y=i["g1"], index=4, round=2,
+            others={i["o02"]: i["f21"]},
+        ),
+        h.reverse_participants[1]: Root(x=i["f10"], y=i["f02b"], index=4, round=2),
+        h.reverse_participants[2]: Root(x=i["f21"], y=i["g1"], index=4, round=2),
+    }
+
+    h.reset(roots)
+    for name in evs:
+        h.insert_event(backup[name], False)
+        h.store.get_event(i[name])
+
+    assert h.known() == {0: 8, 1: 7, 2: 7}
+
+
+def test_get_frame():
+    h, b = build_consensus_graph()
+    i = b.index
+    h.divide_rounds()
+    h.decide_fame()
+    h.find_order()
+
+    expected_roots = {
+        h.reverse_participants[0]: Root(x=i["e02"], y=i["f1b"], index=1, round=0),
+        h.reverse_participants[1]: Root(x=i["e10"], y=i["e02"], index=1, round=0),
+        h.reverse_participants[2]: Root(x=i["e21b"], y=i["f1b"], index=2, round=0),
+    }
+
+    frame = h.get_frame()
+    for p, r in frame.roots.items():
+        er = expected_roots[p]
+        assert (r.x, r.y, r.index, r.round) == (er.x, er.y, er.index, er.round), p
+        assert r.others == er.others, p
+
+    skip = {
+        h.reverse_participants[0]: 1,
+        h.reverse_participants[1]: 1,
+        h.reverse_participants[2]: 2,
+    }
+    expected_events = []
+    for p in frame.roots:
+        for e in h.store.participant_events(p, skip[p]):
+            expected_events.append(h.store.get_event(e))
+    expected_events.sort(key=lambda e: e.topological_index)
+    assert [e.hex() for e in frame.events] == [e.hex() for e in expected_events]
+
+
+def test_reset_from_frame():
+    h, _ = build_consensus_graph()
+    h.divide_rounds()
+    h.decide_fame()
+    h.find_order()
+
+    frame = h.get_frame()
+    h.reset(frame.roots)
+    for ev in frame.events:
+        h.insert_event(ev, False)
+
+    assert h.known() == {0: 8, 1: 7, 2: 7}
+
+    h.divide_rounds()
+    h.decide_fame()
+    h.find_order()
+    assert h.last_consensus_round == 1
+
+
+# ---------------------------------------------------------------- funky
+
+
+def test_funky_fame():
+    h, b = build_funky_graph()
+    h.divide_rounds()
+    assert h.store.last_round() == 5
+    h.decide_fame()
+    # rounds 0-3 decided; 4 (the coin round) and 5 remain
+    assert h.undecided_rounds == [4, 5]
+
+
+def test_funky_blocks():
+    h, _ = build_funky_graph()
+    h.divide_rounds()
+    h.decide_fame()
+    h.find_order()
+    expected = {1: 6, 2: 7, 3: 7}
+    for rr, n_txs in expected.items():
+        b = h.store.get_block(rr)
+        assert len(b.transactions) == n_txs, f"block {rr}"
