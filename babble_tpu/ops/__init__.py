@@ -1,0 +1,40 @@
+"""Batched TPU consensus kernels.
+
+This is the accelerator-native consensus engine: the reference's
+per-event, pointer-chasing pipeline (reference hashgraph/hashgraph.go)
+recast as dense tensor sweeps over a structure-of-arrays event DAG
+resident in HBM.
+
+Key recastings (reference anchors):
+- lastAncestors init = elementwise max of parent rows
+  (hashgraph.go:477-483) -> one wavefront gather/max/scatter per DAG
+  depth level instead of one Go call per event.
+- firstDescendants back-propagation along self-parent chains
+  (hashgraph.go:502-530) -> a *closed form*: last-ancestor columns are
+  monotone along each creator chain, so first_desc[a][c] is a batched
+  binary search (jnp.searchsorted) — no chain walking, no fixpoint.
+- stronglySee = lane-wise compare-and-count >= 2n/3+1
+  (hashgraph.go:179-198) -> broadcast compare against a [rounds, n]
+  witness table (at most one witness per creator per round).
+- DivideRounds (hashgraph.go:616-646) -> the same wavefront sweep that
+  fills coordinates, carrying rounds + the witness table.
+- DecideFame incl. coin rounds (hashgraph.go:649-730) -> one sweep over
+  voting rounds with an [n, rounds*n] vote-matrix contraction.
+- DecideRoundReceived + median consensus timestamps
+  (hashgraph.go:753-799,860-868) -> masked famous-witness see-counts and
+  an on-device sort over dense timestamp ranks (int32; host maps ranks
+  back to nanosecond values, -1 = Go zero time).
+
+Hashing, signatures, and the big-int S tiebreak stay on host; the device
+works purely in int32 event ids.
+"""
+
+from .dag import DagTensors, build_dag
+from .engine import BatchConsensusResult, run_consensus_batch
+
+__all__ = [
+    "DagTensors",
+    "build_dag",
+    "BatchConsensusResult",
+    "run_consensus_batch",
+]

@@ -1,0 +1,156 @@
+"""Batch consensus driver: host orchestration around the device kernels.
+
+Runs the full pipeline (coordinates -> rounds -> fame -> round
+received) on device, then finishes on host exactly as the reference
+does: the final total order sorts by (roundReceived, consensusTimestamp,
+raw big-int S) — the ConsensusSorter with its never-populated PRN quirk
+(reference consensus_sorter.go:21-52) — and blocks group consecutive
+consensus events by roundReceived with Go's nil-vs-empty transaction
+slice semantics (hashgraph.go:826-854).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..gojson import Timestamp, ZERO_TIME
+from ..hashgraph.block import Block
+from ..hashgraph.event import Event
+from ..hashgraph.root import Root
+from ..hashgraph.round_info import Trilean
+from .dag import DagTensors, build_dag
+from . import kernels
+from .kernels import FAME_UNDEFINED, ZERO_TS_RANK
+
+
+@dataclass
+class BatchConsensusResult:
+    dag: DagTensors
+    rounds: np.ndarray  # [E] int32
+    witness: np.ndarray  # [E] bool
+    witness_table: np.ndarray  # [R, n] event ids, -1 empty
+    famous: np.ndarray  # [R, n] trilean
+    round_received: np.ndarray  # [E] int32, -1 undecided
+    cts_rank: np.ndarray  # [E] int32
+    consensus_order: List[str]  # event hexes in consensus order
+    blocks: List[Block]
+    last_consensus_round: Optional[int]
+    undecided_rounds: List[int]
+
+    def round_of(self, ehex: str) -> int:
+        return int(self.rounds[self.dag.hex_to_id[ehex]])
+
+    def witnesses_of_round(self, r: int) -> List[str]:
+        return [
+            self.dag.hexes[int(i)] for i in self.witness_table[r] if int(i) >= 0
+        ]
+
+    def fame_of(self, ehex: str) -> Trilean:
+        eid = self.dag.hex_to_id[ehex]
+        r = int(self.rounds[eid])
+        c = int(self.dag.creator[eid])
+        if int(self.witness_table[r, c]) != eid:
+            return Trilean.UNDEFINED
+        return Trilean(int(self.famous[r, c]))
+
+    def consensus_timestamp(self, eid: int) -> Timestamp:
+        rank = int(self.cts_rank[eid])
+        if rank == ZERO_TS_RANK:
+            return ZERO_TIME
+        return Timestamp(int(self.dag.ts_values[rank]))
+
+
+def run_consensus_batch(
+    events: Sequence[Event],
+    participants: Dict[str, int],
+    roots: Optional[Dict[str, Root]] = None,
+) -> BatchConsensusResult:
+    dag = build_dag(events, participants, roots)
+    n, sm, r = dag.n, dag.super_majority, dag.max_rounds
+
+    la = kernels.compute_last_ancestors(
+        dag.self_parent, dag.other_parent, dag.creator, dag.index, dag.levels, n=n
+    )
+    fd = kernels.compute_first_descendants(
+        la, dag.creator, dag.index, dag.chain, dag.chain_len, n=n
+    )
+    rounds, wit, wt = kernels.compute_rounds(
+        dag.self_parent,
+        dag.other_parent,
+        dag.creator,
+        dag.index,
+        la,
+        fd,
+        dag.levels,
+        dag.root_round,
+        n=n,
+        sm=sm,
+        r=r,
+    )
+    famous = kernels.decide_fame(wt, la, fd, dag.index, dag.coin, n=n, sm=sm, r=r)
+    rr, cts_rank = kernels.decide_round_received(
+        rounds, wt, famous, la, fd, dag.creator, dag.index, dag.chain_rank, n=n, r=r
+    )
+
+    rounds = np.asarray(rounds)
+    wit = np.asarray(wit)
+    wt_np = np.asarray(wt)
+    famous_np = np.asarray(famous)
+    rr = np.asarray(rr)
+    cts_rank = np.asarray(cts_rank)
+
+    # Host finish: total order + block assembly (hashgraph.go:801-858).
+    consensus_ids = [i for i in range(dag.e) if rr[i] >= 0]
+    consensus_ids.sort(
+        key=lambda i: (int(rr[i]), int(cts_rank[i]), int(dag.events[i].s))
+    )
+    consensus_order = [dag.hexes[i] for i in consensus_ids]
+
+    blocks: List[Block] = []
+    block_by_rr: Dict[int, Block] = {}
+    for i in consensus_ids:
+        e = dag.events[i]
+        etxs = e.transactions()
+        b = block_by_rr.get(int(rr[i]))
+        if b is None:
+            b = Block(int(rr[i]), None if etxs is None else list(etxs))
+            block_by_rr[int(rr[i])] = b
+            blocks.append(b)
+        elif etxs:
+            if b.transactions is None:
+                b.transactions = list(etxs)
+            else:
+                b.transactions.extend(etxs)
+
+    # Round bookkeeping mirrors of DecideFame's LastConsensusRound /
+    # UndecidedRounds updates (hashgraph.go:713-729).
+    rounds_present = sorted(set(int(x) for x in rounds))
+    undecided: List[int] = []
+    last_consensus: Optional[int] = None
+    for ri in rounds_present:
+        slots = wt_np[ri]
+        undec = any(
+            int(s) >= 0 and int(famous_np[ri, c]) == FAME_UNDEFINED
+            for c, s in enumerate(slots)
+        )
+        if undec:
+            undecided.append(ri)
+        elif last_consensus is None or ri > last_consensus:
+            last_consensus = ri
+
+    return BatchConsensusResult(
+        dag=dag,
+        rounds=rounds,
+        witness=wit,
+        witness_table=wt_np,
+        famous=famous_np,
+        round_received=rr,
+        cts_rank=cts_rank,
+        consensus_order=consensus_order,
+        blocks=blocks,
+        last_consensus_round=last_consensus,
+        undecided_rounds=undecided,
+    )

@@ -1,0 +1,289 @@
+"""The jitted consensus kernels.
+
+All kernels are pure functions over int32/int8 SoA tensors (see
+dag.DagTensors). Shapes: E = events (+1 sentinel pad row where noted),
+N = participants, R = static round bound, L x W = wavefront levels,
+K = longest creator chain.
+
+Semantics mirror reference hashgraph/hashgraph.go exactly (anchors on
+each kernel); the *computation* is restructured for the TPU: wavefront
+sweeps instead of per-event recursion, a [R, N] witness table instead
+of round LRUs, batched searchsorted instead of chain walking, and a
+vote-matrix contraction instead of nested vote loops.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+INT32_MAX = 2**31 - 1
+# Device stand-in for Go's zero time (reference hashgraph.go:860-868);
+# smaller than every real timestamp rank (>= 0).
+ZERO_TS_RANK = -1
+
+FAME_UNDEFINED = 0
+FAME_TRUE = 1
+FAME_FALSE = 2
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def compute_last_ancestors(self_parent, other_parent, creator, index, levels, *, n):
+    """last_anc[x, i] = index of x's latest ancestor created by i, -1 if
+    none — the coordinate init of reference hashgraph.go:448-499
+    (elementwise max of parent rows, own slot = own index), swept one
+    DAG depth level at a time.
+
+    Per-event inputs are [E+1] with a sentinel pad row at id E; returns
+    la[E, n].
+    """
+    e = self_parent.shape[0] - 1
+    w = levels.shape[1]
+    la = jnp.full((e + 1, n), -1, dtype=jnp.int32)
+
+    def step(l, la):
+        ids = levels[l]  # [W]
+        valid = ids >= 0
+        sids = jnp.where(valid, ids, e)  # pad lanes hit the sentinel row
+        sp = self_parent[sids]
+        op = other_parent[sids]
+        sp_rows = jnp.where((sp >= 0)[:, None], la[jnp.where(sp >= 0, sp, e)], -1)
+        op_rows = jnp.where((op >= 0)[:, None], la[jnp.where(op >= 0, op, e)], -1)
+        rows = jnp.maximum(sp_rows, op_rows)
+        rows = rows.at[jnp.arange(w), creator[sids]].set(index[sids])
+        return la.at[sids].set(jnp.where(valid[:, None], rows, -1))
+
+    la = lax.fori_loop(0, levels.shape[0], step, la)
+    return la[:e]
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def compute_first_descendants(la, creator, index, chain, chain_len, *, n):
+    """first_desc[a, c] = index of the earliest event by creator c that
+    descends from a, INT32_MAX if none — reference
+    hashgraph.go:490-530.
+
+    Closed form instead of the reference's self-parent chain walk:
+    within creator c's chain, last_anc[chain[c,k], i] is monotone
+    nondecreasing in k (children take the elementwise max over
+    parents), so the earliest descendant of a (creator ca, index ia) is
+    the first k with chain_la[c, k, ca] >= ia — one searchsorted per
+    (creator pair, target index).
+
+    la: [E, n]; creator/index: [E+1] padded; chain: [n, K]; returns
+    fd[E, n].
+    """
+    e = la.shape[0]
+    k = chain.shape[1]
+    chain_valid = chain >= 0
+    # [n, K, n]; pad slots sort to the top so searchsorted lands on them
+    # only when no real descendant exists.
+    chain_la = jnp.where(
+        chain_valid[:, :, None],
+        la[jnp.where(chain_valid, chain, 0)],
+        INT32_MAX,
+    )
+    # ranks[c, i, t] = first k with chain_la[c, k, i] >= t, for every
+    # possible target index t in [0, K).
+    cols = jnp.transpose(chain_la, (0, 2, 1))  # [n(c), n(i), K]
+    targets = jnp.arange(k, dtype=jnp.int32)
+    ranks = jax.vmap(jax.vmap(lambda col: jnp.searchsorted(col, targets)))(cols)
+    ranks = ranks.astype(jnp.int32)  # [n(c), n(i), K]
+    fdv = jnp.where(ranks < chain_len[:, None, None], ranks, INT32_MAX)
+    # Scatter back per event: fd[chain[i, t], c] = fdv[c, i, t].
+    fd = jnp.full((e + 1, n), INT32_MAX, dtype=jnp.int32)
+    rows = jnp.broadcast_to(jnp.where(chain_valid, chain, e)[None, :, :], fdv.shape)
+    cidx = jnp.broadcast_to(jnp.arange(n)[:, None, None], fdv.shape)
+    fd = fd.at[rows, cidx].set(fdv)
+    return fd[:e]
+
+
+@functools.partial(jax.jit, static_argnames=("n", "sm", "r"))
+def compute_rounds(
+    self_parent, other_parent, creator, index, la, fd, levels, root_round, *, n, sm, r
+):
+    """Round numbers, witness flags, and the witness table — reference
+    DivideRounds / Round / RoundInc / Witness (hashgraph.go:211-339,
+    616-646), swept per DAG level.
+
+    stronglySee(x, w) (hashgraph.go:179-198) is evaluated only against
+    the <= n candidate witnesses of x's parent round (rounds are
+    monotone along self-parent chains, so each creator contributes at
+    most one witness per round) — [W, n, n] compares per level instead
+    of anything E x E.
+
+    Returns (rounds[E], witness[E] bool, wt[r, n] event ids, -1 empty).
+    """
+    e = la.shape[0]
+    la_p = jnp.concatenate([la, jnp.full((1, n), -1, jnp.int32)], axis=0)
+    rounds = jnp.full((e + 1,), -1, dtype=jnp.int32)
+    wit = jnp.zeros((e + 1,), dtype=jnp.bool_)
+    wt = jnp.full((r + 1, n), -1, dtype=jnp.int32)  # row r = scatter dump
+
+    def step(l, carry):
+        rounds, wit, wt = carry
+        ids = levels[l]
+        valid = ids >= 0
+        sids = jnp.where(valid, ids, e)
+        sp = self_parent[sids]
+        op = other_parent[sids]
+        cr = creator[sids]
+        rnd_sp_raw = jnp.where(sp >= 0, rounds[jnp.where(sp >= 0, sp, e)], -1)
+        # parentRound with Root fallback (hashgraph.go:211-262): a
+        # missing parent means the base Root (X = Y = ""), whose round
+        # comes from root_round.
+        sp_round = jnp.where(sp >= 0, rnd_sp_raw, root_round[cr])
+        op_round = jnp.where(op >= 0, rounds[jnp.where(op >= 0, op, e)], root_round[cr])
+        use_op = sp_round < op_round
+        pr = jnp.where(use_op, op_round, sp_round)
+        pr_root = jnp.where(use_op, op < 0, sp < 0)
+        # roundInc: count parent-round witnesses strongly seen.
+        cand = wt[jnp.clip(pr, 0, r - 1)]  # [W, n]
+        cand_valid = cand >= 0
+        fd_c = fd[jnp.where(cand_valid, cand, 0)]  # [W, n, n]
+        la_x = la_p[sids]  # [W, n]
+        ss = ((la_x[:, None, :] >= fd_c).sum(-1) >= sm) & cand_valid
+        inc = pr_root | (ss.sum(-1) >= sm)
+        r_new = pr + inc.astype(jnp.int32)
+        # witness: sits on the Root, or exceeds the self-parent's round
+        # (hashgraph.go:265-282).
+        w_new = ((sp < 0) & (op < 0)) | (r_new > rnd_sp_raw)
+        rounds = rounds.at[sids].set(jnp.where(valid, r_new, -1))
+        wit = wit.at[sids].set(jnp.where(valid, w_new, False))
+        upd = valid & w_new
+        r_idx = jnp.where(upd, jnp.clip(r_new, 0, r - 1), r)
+        wt = wt.at[r_idx, cr].set(jnp.where(upd, sids, -1))
+        return rounds, wit, wt
+
+    rounds, wit, wt = lax.fori_loop(0, levels.shape[0], step, (rounds, wit, wt))
+    return rounds[:e], wit[:e], wt[:r]
+
+
+@functools.partial(jax.jit, static_argnames=("n", "sm", "r"))
+def decide_fame(wt, la, fd, index, coin, *, n, sm, r):
+    """Virtual voting — reference DecideFame (hashgraph.go:649-730).
+
+    One sweep over voting rounds j: round-j witnesses vote on every
+    earlier witness slot (rx, cx). First-round votes are plain `see`
+    (ancestry); later rounds take the majority over the round-(j-1)
+    witnesses they strongly see, deciding fame on a >= 2n/3+1 tally in
+    normal rounds and flipping the precomputed middle-bit coin in coin
+    rounds (diff % n == 0, hashgraph.go:695-709,1039-1048). Decisions
+    are consistent across deciders (two 2n/3+1 tallies cannot
+    disagree), so the sweep decides without the reference's early-break
+    bookkeeping; votes on already-decided slots are computed but gated
+    out of the fame table, matching the reference where such votes are
+    never read.
+
+    Returns famous[r, n] trilean (0 undefined / 1 true / 2 false).
+    """
+    wt_valid = wt >= 0
+    wt_safe = jnp.where(wt_valid, wt, 0)
+    idx_x = jnp.where(wt_valid, index[wt_safe], -1)  # [r, n]
+    rx = jnp.broadcast_to(jnp.arange(r)[:, None], (r, n))
+    famous0 = jnp.zeros((r, n), dtype=jnp.int32)
+    votes0 = jnp.zeros((n, r, n), dtype=jnp.bool_)
+
+    def step(j, carry):
+        famous, v_prev = carry
+        y = wt[j]
+        y_valid = y >= 0
+        ys = jnp.where(y_valid, y, 0)
+        la_y = la[ys]  # [n, n]
+        see_v = la_y[:, None, :] >= idx_x[None, :, :]  # [n(y), r, n(cx)]
+        wp = wt[j - 1]
+        wp_valid = wp >= 0
+        fd_p = fd[jnp.where(wp_valid, wp, 0)]  # [n, n]
+        ss = ((la_y[:, None, :] >= fd_p[None, :, :]).sum(-1) >= sm) & wp_valid[None, :]
+        yays = (
+            ss.astype(jnp.int32) @ v_prev.reshape(n, r * n).astype(jnp.int32)
+        ).reshape(n, r, n)
+        tot = ss.sum(-1).astype(jnp.int32)[:, None, None]
+        nays = tot - yays
+        v = yays >= nays
+        t = jnp.maximum(yays, nays)
+        diff = j - rx  # [r, n]
+        is_first = (diff == 1)[None]
+        normal = ((diff % n) != 0)[None]
+        coin_vote = jnp.broadcast_to(
+            coin[ys].astype(jnp.bool_)[:, None, None], see_v.shape
+        )
+        vote = jnp.where(
+            is_first, see_v, jnp.where(normal | (t >= sm), v, coin_vote)
+        )
+        active = y_valid[:, None, None] & wt_valid[None] & (rx < j)[None]
+        vote = vote & active
+        decide_now = active & ~is_first & normal & (t >= sm)
+        dec_any = decide_now.any(0)
+        dec_val = (decide_now & v).any(0)
+        undecided = (famous == FAME_UNDEFINED) & wt_valid
+        famous = jnp.where(
+            undecided & dec_any,
+            jnp.where(dec_val, FAME_TRUE, FAME_FALSE),
+            famous,
+        )
+        return famous, vote
+
+    famous, _ = lax.fori_loop(1, r, step, (famous0, votes0))
+    return famous
+
+
+@functools.partial(jax.jit, static_argnames=("n", "r"))
+def decide_round_received(
+    rounds, wt, famous, la, fd, creator, index, chain_rank, *, n, r
+):
+    """Round-received + median consensus timestamps — reference
+    DecideRoundReceived / MedianTimestamp / OldestSelfAncestorToSee
+    (hashgraph.go:753-799,860-868,141-167).
+
+    For each event x and candidate round i (fully decided, with every
+    earlier round decided too), x is received at the first i where a
+    strict majority of i's famous witnesses see it. Its consensus
+    timestamp is the median over those witnesses of the timestamp of
+    x's first descendant on each witness's own chain (Go substitutes
+    the zero time when that descendant doesn't reach the witness;
+    device rank -1 plays that role).
+
+    Returns (round_received[E] int32, -1 undecided;
+             cts_rank[E] int32 timestamp rank, -1 = zero time).
+    """
+    e = rounds.shape[0]
+    k = chain_rank.shape[1]
+    wt_valid = wt >= 0
+    wt_safe = jnp.where(wt_valid, wt, 0)
+    has_undec = ((famous == FAME_UNDEFINED) & wt_valid).any(1)  # [r]
+    min_undec = jnp.min(jnp.where(has_undec, jnp.arange(r), r))
+    fmask = (famous == FAME_TRUE) & wt_valid  # [r, n]
+    fcnt = fmask.sum(1)
+    idx_w = jnp.where(wt_valid, index[wt_safe], -1)  # [r, n]
+    creator_e = creator[:e]
+    index_e = index[:e]
+    # first-descendant pointers per (witness creator, event).
+    kk = fd.T  # [n(c), E]
+    kk_safe = jnp.clip(kk, 0, k - 1)
+    ts_fd = chain_rank[jnp.arange(n)[:, None], kk_safe]  # [n, E]
+
+    rr0 = jnp.full((e,), -1, dtype=jnp.int32)
+    cts0 = jnp.full((e,), ZERO_TS_RANK, dtype=jnp.int32)
+
+    def step(i, carry):
+        rr, cts = carry
+        eligible = ~has_undec[i] & (min_undec > i)
+        la_w = la[wt_safe[i]]  # [n(w), n]
+        see_wx = la_w[:, creator_e] >= index_e[None, :]  # [n(w), E]
+        s_mask = see_wx & fmask[i][:, None]
+        s_cnt = s_mask.sum(0)
+        ok = eligible & (s_cnt > fcnt[i] // 2) & (i > rounds) & (rr < 0)
+        valid_t = kk <= idx_w[i][:, None]  # descendant reaches the witness
+        tsv = jnp.where(valid_t, ts_fd, ZERO_TS_RANK)
+        tvals = jnp.where(s_mask, tsv, INT32_MAX)
+        sorted_t = jnp.sort(tvals, axis=0)
+        med = jnp.take_along_axis(sorted_t, (s_cnt // 2)[None, :], axis=0)[0]
+        rr = jnp.where(ok, i, rr)
+        cts = jnp.where(ok, med, cts)
+        return rr, cts
+
+    return lax.fori_loop(0, r, step, (rr0, cts0))
