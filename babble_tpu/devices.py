@@ -1,0 +1,67 @@
+"""Device-topology helpers.
+
+Multi-chip sharding is tested without hardware by forcing a virtual
+CPU platform with N devices (SURVEY.md §7: shard on a CPU mesh, bench
+on the real chip). In this environment a sitecustomize may have already
+initialized the TPU backend before user code runs, so flipping the
+platform requires clearing JAX's backend cache, not just setting env
+vars."""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_virtual_devices(count: int) -> None:
+    """Make jax.devices() report >= count devices, selecting the
+    virtual CPU platform if needed.
+
+    Ordering matters: probing jax.devices() *initializes* the backend,
+    after which XLA_FLAGS has been parsed and the device count is
+    frozen for the process. So the initialized state is checked via
+    backends_are_initialized() first, and env/config are flipped before
+    any device probe."""
+    import jax
+
+    initialized = False
+    try:
+        from jax._src import xla_bridge
+
+        initialized = xla_bridge.backends_are_initialized()
+    except Exception:  # pragma: no cover - version-dependent private API
+        xla_bridge = None
+
+    if initialized and len(jax.devices()) >= count:
+        return
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    parts = [f for f in flags.split() if "host_platform_device_count" not in f]
+    parts.append(f"--xla_force_host_platform_device_count={count}")
+    os.environ["XLA_FLAGS"] = " ".join(parts)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    if initialized and xla_bridge is not None:
+        # A different platform was selected first (e.g. the TPU via
+        # sitecustomize). Dropping the backend cache lets the CPU
+        # client initialize fresh; this picks up a device-count flag
+        # that was already in XLA_FLAGS at process start, though flags
+        # added only now may be ignored if XLA parsed them already.
+        try:
+            xla_bridge._clear_backends()
+        except Exception:  # pragma: no cover - version-dependent private API
+            pass
+    if len(jax.devices()) >= count:
+        return
+
+    raise RuntimeError(
+        f"could not provision {count} virtual devices "
+        f"(have {len(jax.devices())}); "
+        + (
+            "backends were already initialized — call ensure_virtual_devices "
+            "before any JAX computation, or "
+            if initialized
+            else ""
+        )
+        + f"set XLA_FLAGS=--xla_force_host_platform_device_count={count} "
+        "JAX_PLATFORMS=cpu before starting python"
+    )

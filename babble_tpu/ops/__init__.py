@@ -29,12 +29,16 @@ Hashing, signatures, and the big-int S tiebreak stay on host; the device
 works purely in int32 event ids.
 """
 
-from .dag import DagTensors, build_dag
+from .dag import DagTensors, build_dag, synthetic_dag
 from .engine import BatchConsensusResult, run_consensus_batch
+from .pipeline import consensus_pipeline, run_pipeline
 
 __all__ = [
     "DagTensors",
     "build_dag",
+    "synthetic_dag",
     "BatchConsensusResult",
     "run_consensus_batch",
+    "consensus_pipeline",
+    "run_pipeline",
 ]

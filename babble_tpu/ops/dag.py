@@ -60,10 +60,99 @@ class DagTensors:
         return max(base, 0) + int(self.levels.shape[0]) + 2
 
 
+def _assemble(
+    n: int,
+    e: int,
+    self_parent: np.ndarray,
+    other_parent: np.ndarray,
+    creator: np.ndarray,
+    index: np.ndarray,
+    coin: np.ndarray,
+    ts_rank: np.ndarray,
+    ts_values: np.ndarray,
+    root_round: np.ndarray,
+    hexes: List[str],
+    hex_to_id: Dict[str, int],
+    events: List[Event],
+    max_level_width: Optional[int] = None,
+) -> DagTensors:
+    """Shared tail of DAG assembly: wavefront levels + creator chains.
+
+    `max_level_width` splits wide levels into consecutive rows (events
+    within a level are mutually independent, so any split is valid) to
+    bound the [W, n, n] working set of the round kernel at large n."""
+    # DAG depth levels (wavefront schedule).
+    level = np.zeros(e, dtype=np.int32)
+    for i in range(e):
+        lv = -1
+        sp, op = self_parent[i], other_parent[i]
+        if sp >= 0:
+            lv = max(lv, level[sp])
+        if op >= 0:
+            lv = max(lv, level[op])
+        level[i] = lv + 1
+    n_levels = int(level.max()) + 1 if e else 1
+    buckets: List[List[int]] = [[] for _ in range(n_levels)]
+    for i in range(e):
+        buckets[level[i]].append(i)
+    if max_level_width is not None and max_level_width > 0:
+        chunked: List[List[int]] = []
+        for b in buckets:
+            for off in range(0, max(len(b), 1), max_level_width):
+                chunked.append(b[off : off + max_level_width])
+        buckets = chunked
+    width = max((len(b) for b in buckets), default=1)
+    levels = np.full((len(buckets), width), -1, dtype=np.int32)
+    for l, b in enumerate(buckets):
+        levels[l, : len(b)] = b
+
+    # Per-creator chains: chain[c, k] = id of c's event with index k.
+    k_max = int(index[:e].max()) + 1 if e else 1
+    chain = np.full((n, k_max), -1, dtype=np.int32)
+    chain_len = np.zeros(n, dtype=np.int32)
+    for i in range(e):
+        c, k = int(creator[i]), int(index[i])
+        if chain[c, k] != -1:
+            raise ValueError(f"fork: two events by creator {c} at index {k}")
+        chain[c, k] = i
+    for c in range(n):
+        length = 0
+        while length < k_max and chain[c, length] != -1:
+            length += 1
+        if np.any(chain[c, length:] != -1):
+            raise ValueError(f"non-contiguous chain for creator {c}")
+        chain_len[c] = length
+
+    chain_rank = np.full((n, k_max), -1, dtype=np.int32)
+    valid = chain >= 0
+    chain_rank[valid] = ts_rank[chain[valid]]
+
+    return DagTensors(
+        n=n,
+        e=e,
+        self_parent=self_parent,
+        other_parent=other_parent,
+        creator=creator,
+        index=index,
+        coin=coin,
+        ts_rank=ts_rank,
+        ts_values=ts_values,
+        levels=levels,
+        chain=chain,
+        chain_len=chain_len,
+        chain_rank=chain_rank,
+        root_round=root_round,
+        hexes=hexes,
+        hex_to_id=hex_to_id,
+        events=events,
+    )
+
+
 def build_dag(
     events: Sequence[Event],
     participants: Dict[str, int],
     roots: Optional[Dict[str, Root]] = None,
+    max_level_width: Optional[int] = None,
 ) -> DagTensors:
     """`events` must be in insertion (topological) order — the same
     order the incremental engine would receive them."""
@@ -105,66 +194,90 @@ def build_dag(
     ts_rank = np.zeros(e + 1, dtype=np.int32)
     ts_rank[:e] = ts_rank_e.astype(np.int32)
 
-    # DAG depth levels (wavefront schedule).
-    level = np.zeros(e, dtype=np.int32)
-    for i in range(e):
-        lv = -1
-        if self_parent[i] >= 0:
-            lv = max(lv, level[self_parent[i]])
-        if other_parent[i] >= 0:
-            lv = max(lv, level[other_parent[i]])
-        level[i] = lv + 1
-    n_levels = int(level.max()) + 1 if e else 1
-    buckets: List[List[int]] = [[] for _ in range(n_levels)]
-    for i in range(e):
-        buckets[level[i]].append(i)
-    width = max((len(b) for b in buckets), default=1)
-    levels = np.full((n_levels, width), -1, dtype=np.int32)
-    for l, b in enumerate(buckets):
-        levels[l, : len(b)] = b
-
-    # Per-creator chains: chain[c, k] = id of c's event with index k.
-    k_max = int(index[:e].max()) + 1 if e else 1
-    chain = np.full((n, k_max), -1, dtype=np.int32)
-    chain_len = np.zeros(n, dtype=np.int32)
-    for i in range(e):
-        c, k = int(creator[i]), int(index[i])
-        if chain[c, k] != -1:
-            raise ValueError(f"fork: two events by creator {c} at index {k}")
-        chain[c, k] = i
-    for c in range(n):
-        length = 0
-        while length < k_max and chain[c, length] != -1:
-            length += 1
-        if np.any(chain[c, length:] != -1):
-            raise ValueError(f"non-contiguous chain for creator {c}")
-        chain_len[c] = length
-
-    chain_rank = np.full((n, k_max), -1, dtype=np.int32)
-    valid = chain >= 0
-    chain_rank[valid] = ts_rank[chain[valid]]
-
     root_round = np.full(n, -1, dtype=np.int32)
     if roots:
         for pk, root in roots.items():
             root_round[participants[pk]] = root.round
 
-    return DagTensors(
-        n=n,
-        e=e,
-        self_parent=self_parent,
-        other_parent=other_parent,
-        creator=creator,
-        index=index,
-        coin=coin,
-        ts_rank=ts_rank,
-        ts_values=ts_values,
-        levels=levels,
-        chain=chain,
-        chain_len=chain_len,
-        chain_rank=chain_rank,
-        root_round=root_round,
-        hexes=hexes,
-        hex_to_id=hex_to_id,
-        events=list(events),
+    return _assemble(
+        n,
+        e,
+        self_parent,
+        other_parent,
+        creator,
+        index,
+        coin,
+        ts_rank,
+        ts_values,
+        root_round,
+        hexes,
+        hex_to_id,
+        list(events),
+        max_level_width=max_level_width,
     )
+
+
+def synthetic_dag(
+    n: int,
+    e: int,
+    seed: int = 0,
+    max_level_width: Optional[int] = None,
+):
+    """Generate a random-gossip DAG directly as tensors (no crypto, no
+    Event objects) for benchmarking the device pipeline: each step a
+    random creator records a sync from a random other peer, exactly the
+    event pattern the gossip runtime produces (reference
+    node/node.go:315-487).
+
+    Returns (DagTensors, s_rank[E] int64) where s_rank stands in for
+    the raw big-int signature-S tiebreak of the final sort."""
+    if e < n or n < 2:
+        raise ValueError("need n >= 2 and at least one event per participant")
+    rng = np.random.default_rng(seed)
+    self_parent = np.full(e + 1, -1, dtype=np.int32)
+    other_parent = np.full(e + 1, -1, dtype=np.int32)
+    creator = np.zeros(e + 1, dtype=np.int32)
+    index = np.zeros(e + 1, dtype=np.int32)
+
+    heads = np.full(n, -1, dtype=np.int64)
+    seqs = np.full(n, -1, dtype=np.int64)
+    creators = np.concatenate(
+        [np.arange(n, dtype=np.int64), rng.integers(0, n, size=e - n)]
+    )
+    others = rng.integers(1, n, size=e)  # offset, so other != creator
+    for i in range(e):
+        c = int(creators[i])
+        if i >= n:
+            j = (c + int(others[i])) % n
+            other_parent[i] = heads[j]
+        self_parent[i] = heads[c]
+        seqs[c] += 1
+        creator[i] = c
+        index[i] = seqs[c]
+        heads[c] = i
+
+    coin = np.zeros(e + 1, dtype=np.int8)
+    coin[:e] = rng.integers(0, 2, size=e, dtype=np.int8)
+    ts_rank = np.zeros(e + 1, dtype=np.int32)
+    ts_rank[:e] = np.arange(e, dtype=np.int32)  # monotone clock
+    ts_values = np.arange(e, dtype=np.int64)
+    root_round = np.full(n, -1, dtype=np.int32)
+    s_rank = rng.integers(0, 2**62, size=e, dtype=np.int64)
+
+    dag = _assemble(
+        n,
+        e,
+        self_parent,
+        other_parent,
+        creator,
+        index,
+        coin,
+        ts_rank,
+        ts_values,
+        root_round,
+        hexes=[],
+        hex_to_id={},
+        events=[],
+        max_level_width=max_level_width,
+    )
+    return dag, s_rank

@@ -22,8 +22,8 @@ from ..hashgraph.event import Event
 from ..hashgraph.root import Root
 from ..hashgraph.round_info import Trilean
 from .dag import DagTensors, build_dag
-from . import kernels
 from .kernels import FAME_UNDEFINED, ZERO_TS_RANK
+from .pipeline import run_pipeline
 
 
 @dataclass
@@ -69,31 +69,7 @@ def run_consensus_batch(
     roots: Optional[Dict[str, Root]] = None,
 ) -> BatchConsensusResult:
     dag = build_dag(events, participants, roots)
-    n, sm, r = dag.n, dag.super_majority, dag.max_rounds
-
-    la = kernels.compute_last_ancestors(
-        dag.self_parent, dag.other_parent, dag.creator, dag.index, dag.levels, n=n
-    )
-    fd = kernels.compute_first_descendants(
-        la, dag.creator, dag.index, dag.chain, dag.chain_len, n=n
-    )
-    rounds, wit, wt = kernels.compute_rounds(
-        dag.self_parent,
-        dag.other_parent,
-        dag.creator,
-        dag.index,
-        la,
-        fd,
-        dag.levels,
-        dag.root_round,
-        n=n,
-        sm=sm,
-        r=r,
-    )
-    famous = kernels.decide_fame(wt, la, fd, dag.index, dag.coin, n=n, sm=sm, r=r)
-    rr, cts_rank = kernels.decide_round_received(
-        rounds, wt, famous, la, fd, dag.creator, dag.index, dag.chain_rank, n=n, r=r
-    )
+    rounds, wit, wt, famous, rr, cts_rank = run_pipeline(dag)
 
     rounds = np.asarray(rounds)
     wit = np.asarray(wit)

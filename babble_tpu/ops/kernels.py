@@ -198,9 +198,13 @@ def decide_fame(wt, la, fd, index, coin, *, n, sm, r):
         wp_valid = wp >= 0
         fd_p = fd[jnp.where(wp_valid, wp, 0)]  # [n, n]
         ss = ((la_y[:, None, :] >= fd_p[None, :, :]).sum(-1) >= sm) & wp_valid[None, :]
+        # f32 contraction rides the MXU; tallies are <= n < 2^24 so
+        # float32 arithmetic is exact.
         yays = (
-            ss.astype(jnp.int32) @ v_prev.reshape(n, r * n).astype(jnp.int32)
-        ).reshape(n, r, n)
+            (ss.astype(jnp.float32) @ v_prev.reshape(n, r * n).astype(jnp.float32))
+            .astype(jnp.int32)
+            .reshape(n, r, n)
+        )
         tot = ss.sum(-1).astype(jnp.int32)[:, None, None]
         nays = tot - yays
         v = yays >= nays
@@ -247,6 +251,12 @@ def decide_round_received(
     the zero time when that descendant doesn't reach the witness;
     device rank -1 plays that role).
 
+    Two phases: a cheap sweep over candidate rounds finds each event's
+    receiving round; one vectorized pass then computes the medians
+    against only the deciding round's witnesses (the reference
+    recomputes per (event, round) pair; the result is identical because
+    only the first qualifying round's witnesses contribute).
+
     Returns (round_received[E] int32, -1 undecided;
              cts_rank[E] int32 timestamp rank, -1 = zero time).
     """
@@ -261,29 +271,34 @@ def decide_round_received(
     idx_w = jnp.where(wt_valid, index[wt_safe], -1)  # [r, n]
     creator_e = creator[:e]
     index_e = index[:e]
-    # first-descendant pointers per (witness creator, event).
-    kk = fd.T  # [n(c), E]
-    kk_safe = jnp.clip(kk, 0, k - 1)
-    ts_fd = chain_rank[jnp.arange(n)[:, None], kk_safe]  # [n, E]
 
+    # Phase 1: first qualifying round per event.
     rr0 = jnp.full((e,), -1, dtype=jnp.int32)
-    cts0 = jnp.full((e,), ZERO_TS_RANK, dtype=jnp.int32)
 
-    def step(i, carry):
-        rr, cts = carry
+    def step(i, rr):
         eligible = ~has_undec[i] & (min_undec > i)
         la_w = la[wt_safe[i]]  # [n(w), n]
         see_wx = la_w[:, creator_e] >= index_e[None, :]  # [n(w), E]
-        s_mask = see_wx & fmask[i][:, None]
-        s_cnt = s_mask.sum(0)
+        s_cnt = (see_wx & fmask[i][:, None]).sum(0)
         ok = eligible & (s_cnt > fcnt[i] // 2) & (i > rounds) & (rr < 0)
-        valid_t = kk <= idx_w[i][:, None]  # descendant reaches the witness
-        tsv = jnp.where(valid_t, ts_fd, ZERO_TS_RANK)
-        tvals = jnp.where(s_mask, tsv, INT32_MAX)
-        sorted_t = jnp.sort(tvals, axis=0)
-        med = jnp.take_along_axis(sorted_t, (s_cnt // 2)[None, :], axis=0)[0]
-        rr = jnp.where(ok, i, rr)
-        cts = jnp.where(ok, med, cts)
-        return rr, cts
+        return jnp.where(ok, i, rr)
 
-    return lax.fori_loop(0, r, step, (rr0, cts0))
+    rr = lax.fori_loop(0, r, step, rr0)
+
+    # Phase 2: medians against each event's own receiving round.
+    rr_safe = jnp.clip(rr, 0, r - 1)
+    w_sel = wt_safe[rr_safe]  # [E, n] witness ids of the receiving round
+    fm_sel = fmask[rr_safe]  # [E, n]
+    idxw_sel = idx_w[rr_safe]  # [E, n]
+    see_sel = la[w_sel, creator_e[:, None]] >= index_e[:, None]  # [E, n]
+    s_mask = see_sel & fm_sel
+    s_cnt = s_mask.sum(1)
+    kk = fd  # [E, n]: first descendant of x on each witness creator's chain
+    valid_t = kk <= idxw_sel  # descendant reaches the witness
+    ts_fd = chain_rank[jnp.arange(n)[None, :], jnp.clip(kk, 0, k - 1)]  # [E, n]
+    tsv = jnp.where(valid_t, ts_fd, ZERO_TS_RANK)
+    tvals = jnp.where(s_mask, tsv, INT32_MAX)
+    sorted_t = jnp.sort(tvals, axis=1)
+    med = jnp.take_along_axis(sorted_t, (s_cnt // 2)[:, None], axis=1)[:, 0]
+    cts = jnp.where(rr >= 0, med, ZERO_TS_RANK)
+    return rr, cts

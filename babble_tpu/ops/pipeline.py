@@ -1,0 +1,65 @@
+"""Fused end-to-end consensus pipeline: one jitted function from raw
+DAG tensors to (rounds, witness flags, witness table, fame, round
+received, consensus timestamps). This is the framework's flagship
+compiled step — XLA fuses across the five kernels and a single dispatch
+covers the whole reference pipeline DivideRounds -> DecideFame ->
+FindOrder (reference node/core.go:277-296, hashgraph.go:616-858)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from . import kernels
+
+
+@functools.partial(jax.jit, static_argnames=("n", "sm", "r"))
+def consensus_pipeline(
+    self_parent,
+    other_parent,
+    creator,
+    index,
+    coin,
+    levels,
+    root_round,
+    chain,
+    chain_len,
+    chain_rank,
+    *,
+    n: int,
+    sm: int,
+    r: int,
+):
+    la = kernels.compute_last_ancestors(
+        self_parent, other_parent, creator, index, levels, n=n
+    )
+    fd = kernels.compute_first_descendants(la, creator, index, chain, chain_len, n=n)
+    rounds, wit, wt = kernels.compute_rounds(
+        self_parent, other_parent, creator, index, la, fd, levels, root_round,
+        n=n, sm=sm, r=r,
+    )
+    famous = kernels.decide_fame(wt, la, fd, index, coin, n=n, sm=sm, r=r)
+    rr, cts = kernels.decide_round_received(
+        rounds, wt, famous, la, fd, creator, index, chain_rank, n=n, r=r
+    )
+    return rounds, wit, wt, famous, rr, cts
+
+
+def run_pipeline(dag):
+    """Convenience wrapper over a DagTensors."""
+    return consensus_pipeline(
+        dag.self_parent,
+        dag.other_parent,
+        dag.creator,
+        dag.index,
+        dag.coin,
+        dag.levels,
+        dag.root_round,
+        dag.chain,
+        dag.chain_len,
+        dag.chain_rank,
+        n=dag.n,
+        sm=dag.super_majority,
+        r=dag.max_rounds,
+    )

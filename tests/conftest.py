@@ -1,11 +1,16 @@
 """Test environment: force JAX onto CPU with 8 virtual devices so
-multi-chip sharding paths compile and execute without TPU hardware."""
+multi-chip sharding paths compile and execute without TPU hardware.
+
+Env vars alone are not enough here: the environment's sitecustomize
+initializes the TPU backend before pytest starts, so we go through
+babble_tpu.devices.ensure_virtual_devices, which clears the backend
+cache and re-initializes onto the virtual CPU platform."""
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from babble_tpu.devices import ensure_virtual_devices
+
+ensure_virtual_devices(8)
