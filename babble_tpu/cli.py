@@ -1,0 +1,176 @@
+"""The babble CLI: keygen | run | version.
+
+Reference cmd/babble/main.go:27-290 — same 13 flags, same datadir
+conventions (priv_key.pem + peers.json), same startup sequence: load
+key, load peers, assign participant ids by sorted-pubkey order, build
+store/transport/proxy/node/service, run.
+
+Usage: python -m babble_tpu.cli run --datadir /path --node_addr ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from . import __version__, crypto
+from .crypto.pem import PemKey, generate_pem_key
+from .hashgraph import FileStore, InmemStore
+from .net import JSONPeers, TCPTransport, sort_peers_by_pub_key
+from .node import Config, Node
+from .proxy import InmemAppProxy, SocketAppProxy
+from .service import Service
+
+DEFAULT_NODE_ADDR = "127.0.0.1:1337"
+DEFAULT_PROXY_ADDR = "127.0.0.1:1338"
+DEFAULT_CLIENT_ADDR = "127.0.0.1:1339"
+DEFAULT_SERVICE_ADDR = "127.0.0.1:8000"
+
+
+def default_datadir() -> str:
+    # reference cmd/babble/main.go defaultDataDir(): ~/.babble
+    return os.path.join(os.path.expanduser("~"), ".babble_tpu")
+
+
+def cmd_keygen(args) -> int:
+    pem_dump = generate_pem_key()
+    print(f"PublicKey: {pem_dump.public_key}")
+    if args.datadir:
+        os.makedirs(args.datadir, exist_ok=True)
+        path = os.path.join(args.datadir, "priv_key.pem")
+        with open(path, "w") as f:
+            f.write(pem_dump.private_key)
+        print(f"written to {path}")
+    else:
+        sys.stdout.write(pem_dump.private_key)
+    return 0
+
+
+def cmd_version(_args) -> int:
+    print(__version__)
+    return 0
+
+
+def cmd_run(args) -> int:
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    logger = logging.getLogger("babble_tpu")
+
+    datadir = args.datadir
+    key = PemKey(datadir).read_key()
+    peers = sort_peers_by_pub_key(JSONPeers(datadir).peers())
+    pmap = {p.pub_key_hex: i for i, p in enumerate(peers)}
+
+    my_pub = "0x" + crypto.pub_key_bytes(key).hex().upper()
+    if my_pub not in pmap:
+        print(f"error: public key {my_pub[:20]}… not found in peers.json",
+              file=sys.stderr)
+        return 1
+    node_id = pmap[my_pub]
+
+    conf = Config(
+        heartbeat_timeout=args.heartbeat / 1000.0,
+        tcp_timeout=args.tcp_timeout / 1000.0,
+        cache_size=args.cache_size,
+        sync_limit=args.sync_limit,
+        store_type=args.store,
+        store_path=args.store_path or os.path.join(datadir, "store.db"),
+        logger=logger,
+    )
+
+    needs_bootstrap = False
+    if conf.store_type == "file":
+        if os.path.exists(conf.store_path):
+            store = FileStore.load(conf.cache_size, conf.store_path)
+            needs_bootstrap = True
+        else:
+            store = FileStore(pmap, conf.cache_size, conf.store_path)
+    else:
+        store = InmemStore(pmap, conf.cache_size)
+
+    trans = TCPTransport(
+        args.node_addr, max_pool=args.max_pool, timeout=conf.tcp_timeout
+    )
+
+    if args.no_client:
+        proxy = InmemAppProxy()
+    else:
+        proxy = SocketAppProxy(
+            args.client_addr, args.proxy_addr, timeout=conf.tcp_timeout
+        )
+
+    node = Node(conf, node_id, key, peers, store, trans, proxy)
+    node.init(bootstrap=needs_bootstrap)
+
+    service = Service(args.service_addr, node)
+    service.serve_async()
+    logger.info(
+        "node %d on %s (service %s, store %s)",
+        node_id, trans.local_addr(), service.addr, conf.store_type,
+    )
+
+    try:
+        node.run(gossip=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        node.shutdown()
+        service.close()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="babble_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    kg = sub.add_parser("keygen", help="create new key pair")
+    kg.add_argument("--datadir", default="", help="write priv_key.pem here")
+    kg.set_defaults(fn=cmd_keygen)
+
+    rn = sub.add_parser("run", help="run a babble node")
+    rn.add_argument("--datadir", default=default_datadir(),
+                    help="directory with priv_key.pem and peers.json")
+    rn.add_argument("--node_addr", default=DEFAULT_NODE_ADDR,
+                    help="IP:Port to bind the gossip transport")
+    rn.add_argument("--no_client", action="store_true",
+                    help="run without an app client (inmem proxy)")
+    rn.add_argument("--proxy_addr", default=DEFAULT_PROXY_ADDR,
+                    help="IP:Port to bind the app proxy server")
+    rn.add_argument("--client_addr", default=DEFAULT_CLIENT_ADDR,
+                    help="IP:Port of the app client")
+    rn.add_argument("--service_addr", default=DEFAULT_SERVICE_ADDR,
+                    help="IP:Port to bind the HTTP service")
+    rn.add_argument("--log_level", default="info",
+                    help="debug, info, warn, error")
+    rn.add_argument("--heartbeat", type=int, default=1000,
+                    help="heartbeat timer in milliseconds")
+    rn.add_argument("--max_pool", type=int, default=2,
+                    help="max number of pooled connections")
+    rn.add_argument("--tcp_timeout", type=int, default=1000,
+                    help="TCP timeout in milliseconds")
+    rn.add_argument("--cache_size", type=int, default=500,
+                    help="number of items in LRU caches")
+    rn.add_argument("--sync_limit", type=int, default=1000,
+                    help="max number of events per sync")
+    rn.add_argument("--store", default="inmem", choices=["inmem", "file"],
+                    help="store backend")
+    rn.add_argument("--store_path", default="",
+                    help="path of the file store database")
+    rn.set_defaults(fn=cmd_run)
+
+    vs = sub.add_parser("version", help="print version")
+    vs.set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

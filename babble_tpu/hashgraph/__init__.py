@@ -5,6 +5,7 @@ from .frame import Frame
 from .round_info import RoundInfo, RoundEvent, Trilean
 from .store import Store
 from .inmem_store import InmemStore
+from .file_store import FileStore
 from .graph import Hashgraph
 from .participant_events import ParticipantEventsCache
 
@@ -23,6 +24,7 @@ __all__ = [
     "Trilean",
     "Store",
     "InmemStore",
+    "FileStore",
     "Hashgraph",
     "ParticipantEventsCache",
 ]

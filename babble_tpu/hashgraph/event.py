@@ -205,6 +205,28 @@ class Event(GoStruct):
         return f"Event({self.creator()[:10]}#{self.index()})"
 
 
+def event_from_json_obj(obj: dict) -> "Event":
+    """Reconstruct a full signed Event from its Go-JSON encoding (the
+    exact bytes `marshal()` produces), for persistent-store replay.
+    Round-trips exactly: re-marshaling the parsed event reproduces the
+    original bytes, so hashes and signatures survive storage."""
+    body_obj = obj["Body"]
+    txs = body_obj.get("Transactions")
+    if txs is not None:
+        txs = [t if isinstance(t, bytes) else base64.b64decode(t) for t in txs]
+    creator = body_obj["Creator"]
+    if not isinstance(creator, bytes):
+        creator = base64.b64decode(creator)
+    body = EventBody(
+        transactions=txs,
+        parents=list(body_obj["Parents"]),
+        creator=creator,
+        timestamp=Timestamp.parse(body_obj["Timestamp"]),
+        index=body_obj["Index"],
+    )
+    return Event(body, r=obj["R"], s=obj["S"])
+
+
 class WireBody(GoStruct):
     go_fields = (
         ("Transactions", "transactions"),

@@ -1,0 +1,323 @@
+"""Persistent write-through store: the BadgerStore analog on sqlite3.
+
+Reference hashgraph/badger_store.go:28-386. Layering matches the
+reference: an InmemStore is the hot cache; every write also lands in
+the database; reads fall back to the database when the cache misses
+(LRU eviction / fresh restart). The topologically-keyed event log
+(`topo_%09d` keys there, an autoincrement rowid-ordered table here)
+feeds `Hashgraph.bootstrap()` replay.
+
+sqlite3 is the idiomatic stand-in for the embedded Badger KV store: in
+the standard library, single-file, crash-safe."""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..common import StoreError, StoreErrType
+from .block import Block
+from .event import Event, event_from_json_obj
+from .inmem_store import InmemStore
+from .root import Root, new_base_root
+from .round_info import RoundInfo, RoundEvent, Trilean
+
+
+def _round_to_json(info: RoundInfo) -> str:
+    return json.dumps(
+        {
+            "Events": {
+                x: {"Witness": e.witness, "Famous": int(e.famous)}
+                for x, e in info.events.items()
+            }
+        }
+    )
+
+
+def _round_from_json(data: str) -> RoundInfo:
+    obj = json.loads(data)
+    info = RoundInfo()
+    for x, e in (obj.get("Events") or {}).items():
+        info.events[x] = RoundEvent(
+            witness=e["Witness"], famous=Trilean(e["Famous"])
+        )
+    return info
+
+
+class FileStore:
+    """20-method Store (hashgraph/store.go:3-25) with durability."""
+
+    def __init__(
+        self,
+        participants: Dict[str, int],
+        cache_size: int,
+        path: str,
+        create: bool = True,
+    ):
+        self.path = path
+        self._lock = threading.RLock()
+        exists = os.path.exists(path)
+        if not exists and not create:
+            raise StoreError(StoreErrType.KEY_NOT_FOUND, path)
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        self._init_schema()
+
+        if exists and not create:
+            participants = self._db_participants()
+        elif participants:
+            self._db_set_participants(participants)
+        self.inmem = InmemStore(participants, cache_size)
+        self._participants = participants
+
+    @classmethod
+    def load(cls, cache_size: int, path: str) -> "FileStore":
+        """Reopen an existing store, reading participants from disk —
+        reference LoadBadgerStore (badger_store.go:54-83)."""
+        return cls({}, cache_size, path, create=False)
+
+    def _init_schema(self) -> None:
+        with self._lock:
+            self._db.executescript(
+                """
+                CREATE TABLE IF NOT EXISTS events (
+                    seq INTEGER PRIMARY KEY AUTOINCREMENT,
+                    hex TEXT UNIQUE NOT NULL,
+                    creator TEXT NOT NULL,
+                    idx INTEGER NOT NULL,
+                    topo INTEGER NOT NULL,
+                    data TEXT NOT NULL
+                );
+                CREATE INDEX IF NOT EXISTS events_by_participant
+                    ON events (creator, idx);
+                CREATE TABLE IF NOT EXISTS rounds (
+                    idx INTEGER PRIMARY KEY, data TEXT NOT NULL);
+                CREATE TABLE IF NOT EXISTS blocks (
+                    rr INTEGER PRIMARY KEY, data TEXT NOT NULL);
+                CREATE TABLE IF NOT EXISTS participants (
+                    pubkey TEXT PRIMARY KEY, id INTEGER NOT NULL);
+                CREATE TABLE IF NOT EXISTS roots (
+                    pubkey TEXT PRIMARY KEY, data TEXT NOT NULL);
+                """
+            )
+            self._db.commit()
+
+    # -- participants / roots ---------------------------------------------
+
+    def _db_set_participants(self, participants: Dict[str, int]) -> None:
+        with self._lock:
+            self._db.executemany(
+                "INSERT OR REPLACE INTO participants VALUES (?, ?)",
+                list(participants.items()),
+            )
+            self._db.executemany(
+                "INSERT OR REPLACE INTO roots VALUES (?, ?)",
+                [
+                    (pk, json.dumps(new_base_root().to_dict()))
+                    for pk in participants
+                ],
+            )
+            self._db.commit()
+
+    def _db_participants(self) -> Dict[str, int]:
+        with self._lock:
+            rows = self._db.execute("SELECT pubkey, id FROM participants").fetchall()
+        return {pk: pid for pk, pid in rows}
+
+    # -- Store interface ---------------------------------------------------
+
+    def cache_size(self) -> int:
+        return self.inmem.cache_size()
+
+    def participants(self) -> Dict[str, int]:
+        return self._participants
+
+    def get_event(self, key: str) -> Event:
+        try:
+            return self.inmem.get_event(key)
+        except StoreError:
+            pass
+        with self._lock:
+            row = self._db.execute(
+                "SELECT data, topo FROM events WHERE hex = ?", (key,)
+            ).fetchone()
+        if row is None:
+            raise StoreError(StoreErrType.KEY_NOT_FOUND, key)
+        ev = event_from_json_obj(json.loads(row[0]))
+        ev.topological_index = row[1]
+        return ev
+
+    def set_event(self, event: Event) -> None:
+        self.inmem.set_event(event)
+        obj = json.loads(event.marshal())
+        with self._lock:
+            # Replay order is the autoincrement seq (stable across
+            # Reset, which restarts topological_index at 0); the topo
+            # column preserves the engine-assigned index for reload.
+            # Coordinate back-propagation re-calls set_event on old
+            # events whose marshaled bytes never change, so conflicts
+            # only refresh topo.
+            self._db.execute(
+                "INSERT INTO events (hex, creator, idx, topo, data) "
+                "VALUES (?, ?, ?, ?, ?) "
+                "ON CONFLICT(hex) DO UPDATE SET topo = excluded.topo",
+                (
+                    event.hex(),
+                    event.creator(),
+                    event.index(),
+                    event.topological_index,
+                    json.dumps(obj),
+                ),
+            )
+            self._db.commit()
+
+    def participant_events(self, participant: str, skip: int) -> List[str]:
+        try:
+            return self.inmem.participant_events(participant, skip)
+        except StoreError:
+            with self._lock:
+                rows = self._db.execute(
+                    "SELECT hex FROM events WHERE creator = ? AND idx > ? "
+                    "ORDER BY idx",
+                    (participant, skip),
+                ).fetchall()
+            return [r[0] for r in rows]
+
+    def participant_event(self, participant: str, index: int) -> str:
+        try:
+            return self.inmem.participant_event(participant, index)
+        except StoreError:
+            with self._lock:
+                row = self._db.execute(
+                    "SELECT hex FROM events WHERE creator = ? AND idx = ?",
+                    (participant, index),
+                ).fetchone()
+            if row is None:
+                raise StoreError(StoreErrType.KEY_NOT_FOUND, participant)
+            return row[0]
+
+    def last_from(self, participant: str) -> Tuple[str, bool]:
+        return self.inmem.last_from(participant)
+
+    def known(self) -> Dict[int, int]:
+        return self.inmem.known()
+
+    def consensus_events(self) -> List[str]:
+        return self.inmem.consensus_events()
+
+    def consensus_events_count(self) -> int:
+        return self.inmem.consensus_events_count()
+
+    def add_consensus_event(self, key: str) -> None:
+        self.inmem.add_consensus_event(key)
+
+    def get_round(self, r: int) -> RoundInfo:
+        try:
+            return self.inmem.get_round(r)
+        except StoreError:
+            pass
+        with self._lock:
+            row = self._db.execute(
+                "SELECT data FROM rounds WHERE idx = ?", (r,)
+            ).fetchone()
+        if row is None:
+            raise StoreError(StoreErrType.KEY_NOT_FOUND, str(r))
+        return _round_from_json(row[0])
+
+    def set_round(self, r: int, round_info: RoundInfo) -> None:
+        self.inmem.set_round(r, round_info)
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO rounds VALUES (?, ?)",
+                (r, _round_to_json(round_info)),
+            )
+            self._db.commit()
+
+    def last_round(self) -> int:
+        lr = self.inmem.last_round()
+        if lr >= 0:
+            return lr
+        with self._lock:
+            row = self._db.execute("SELECT MAX(idx) FROM rounds").fetchone()
+        return row[0] if row and row[0] is not None else -1
+
+    def round_witnesses(self, r: int) -> List[str]:
+        try:
+            return self.get_round(r).witnesses()
+        except StoreError:
+            return []
+
+    def round_events(self, r: int) -> int:
+        try:
+            return len(self.get_round(r).events)
+        except StoreError:
+            return 0
+
+    def get_root(self, participant: str) -> Root:
+        try:
+            return self.inmem.get_root(participant)
+        except StoreError:
+            pass
+        with self._lock:
+            row = self._db.execute(
+                "SELECT data FROM roots WHERE pubkey = ?", (participant,)
+            ).fetchone()
+        if row is None:
+            raise StoreError(StoreErrType.NO_ROOT, participant)
+        return Root.from_dict(json.loads(row[0]))
+
+    def get_block(self, rr: int) -> Block:
+        try:
+            return self.inmem.get_block(rr)
+        except StoreError:
+            pass
+        with self._lock:
+            row = self._db.execute(
+                "SELECT data FROM blocks WHERE rr = ?", (rr,)
+            ).fetchone()
+        if row is None:
+            raise StoreError(StoreErrType.KEY_NOT_FOUND, str(rr))
+        return Block.from_json_obj(json.loads(row[0]))
+
+    def set_block(self, block: Block) -> None:
+        self.inmem.set_block(block)
+        data = json.dumps(block.to_json_obj())
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO blocks VALUES (?, ?)",
+                (block.round_received, data),
+            )
+            self._db.commit()
+
+    def reset(self, roots: Dict[str, Root]) -> None:
+        self.inmem.reset(roots)
+        with self._lock:
+            self._db.executemany(
+                "INSERT OR REPLACE INTO roots VALUES (?, ?)",
+                [(pk, json.dumps(r.to_dict())) for pk, r in roots.items()],
+            )
+            self._db.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.commit()
+            self._db.close()
+
+    # -- bootstrap feed ----------------------------------------------------
+
+    def db_topological_events(self) -> Iterator[Event]:
+        """Replay the event log in insertion order — reference
+        dbTopologicalEvents (badger_store.go:345-386). Consumed by
+        Hashgraph.bootstrap()."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT data, topo FROM events ORDER BY seq"
+            ).fetchall()
+        for data, topo in rows:
+            ev = event_from_json_obj(json.loads(data))
+            ev.topological_index = topo
+            yield ev

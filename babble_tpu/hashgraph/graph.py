@@ -710,12 +710,23 @@ class Hashgraph:
 
     def bootstrap(self) -> None:
         """Replay a persistent store's topological event log and recompute
-        consensus to the tip (hashgraph.go:1008-1037)."""
+        consensus to the tip (hashgraph.go:1008-1037).
+
+        Commit callbacks are suppressed during replay: recovery rebuilds
+        state that was already delivered to the application before the
+        restart, so re-emitting every historical block would double-apply
+        app state (and, with a bounded commit queue and no consumer
+        running yet, deadlock startup)."""
         db_events = getattr(self.store, "db_topological_events", None)
         if db_events is None:
             return
-        for e in db_events():
-            self.insert_event(e, True)
-        self.divide_rounds()
-        self.decide_fame()
-        self.find_order()
+        saved_cb = self.commit_callback
+        self.commit_callback = None
+        try:
+            for e in db_events():
+                self.insert_event(e, True)
+            self.divide_rounds()
+            self.decide_fame()
+            self.find_order()
+        finally:
+            self.commit_callback = saved_cb

@@ -1,0 +1,210 @@
+"""TCP transport with the reference wire protocol.
+
+Reference net/net_transport.go:33-46,147-390 + tcp_transport.go:48-91:
+- request: 1 framing byte (0x00 Sync, 0x01 EagerSync) + JSON body
+- response: JSON error string ("" = ok) + JSON payload
+- pooled outbound connections per target, capped at max_pool
+- a listener thread accepts connections; each connection gets a handler
+  thread that dispatches inbound RPCs to the consumer queue and writes
+  the response back.
+
+Bodies are encoded exactly as Go's encoding/json would (field names,
+base64 []byte, RFC3339Nano timestamps), one JSON value per line — Go's
+json.Encoder also terminates values with '\n', so the framing is
+byte-compatible in both directions."""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import threading
+from typing import Dict, List, Optional
+
+from .transport import (
+    RPC,
+    EagerSyncRequest,
+    EagerSyncResponse,
+    SyncRequest,
+    SyncResponse,
+    Transport,
+    TransportError,
+)
+
+RPC_SYNC = 0x00
+RPC_EAGER_SYNC = 0x01
+
+
+def _b64_bytes(obj):
+    import base64
+
+    if isinstance(obj, (bytes, bytearray)):
+        return base64.b64encode(bytes(obj)).decode()
+    raise TypeError(f"not JSON serializable: {type(obj)}")
+
+
+class _Conn:
+    """One pooled connection: socket + buffered reader."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.reader = sock.makefile("rb")
+
+    def send_json(self, obj) -> None:
+        self.sock.sendall(json.dumps(obj, default=_b64_bytes).encode() + b"\n")
+
+    def recv_json(self):
+        line = self.reader.readline()
+        if not line:
+            raise TransportError("connection closed")
+        return json.loads(line)
+
+    def close(self) -> None:
+        try:
+            self.reader.close()
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class TCPTransport:
+    def __init__(
+        self,
+        bind_addr: str,
+        advertise: Optional[str] = None,
+        max_pool: int = 3,
+        timeout: float = 1.0,
+    ):
+        host, port_s = bind_addr.rsplit(":", 1)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port_s)))
+        self._listener.listen(64)
+        bound_port = self._listener.getsockname()[1]
+        self._addr = advertise or f"{host}:{bound_port}"
+        if self._addr.startswith(":"):
+            raise TransportError("local bind address is not advertisable")
+
+        self._consumer: "queue.Queue[RPC]" = queue.Queue(16)
+        self._pool: Dict[str, List[_Conn]] = {}
+        self._pool_lock = threading.Lock()
+        self._max_pool = max_pool
+        self._timeout = timeout
+        self._shutdown = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # -- Transport interface ----------------------------------------------
+
+    def consumer(self) -> "queue.Queue[RPC]":
+        return self._consumer
+
+    def local_addr(self) -> str:
+        return self._addr
+
+    def sync(self, target: str, args: SyncRequest) -> SyncResponse:
+        out = self._generic_rpc(target, RPC_SYNC, args.to_dict())
+        return SyncResponse.from_dict(out)
+
+    def eager_sync(self, target: str, args: EagerSyncRequest) -> EagerSyncResponse:
+        out = self._generic_rpc(target, RPC_EAGER_SYNC, args.to_dict())
+        return EagerSyncResponse.from_dict(out)
+
+    def close(self) -> None:
+        self._shutdown.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._pool_lock:
+            for conns in self._pool.values():
+                for c in conns:
+                    c.close()
+            self._pool = {}
+
+    # -- outbound ----------------------------------------------------------
+
+    def _get_conn(self, target: str) -> _Conn:
+        with self._pool_lock:
+            conns = self._pool.get(target)
+            if conns:
+                return conns.pop()
+        host, port_s = target.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port_s)), timeout=self._timeout)
+        sock.settimeout(self._timeout)
+        return _Conn(sock)
+
+    def _return_conn(self, target: str, conn: _Conn) -> None:
+        with self._pool_lock:
+            conns = self._pool.setdefault(target, [])
+            if len(conns) < self._max_pool and not self._shutdown.is_set():
+                conns.append(conn)
+                return
+        conn.close()
+
+    def _generic_rpc(self, target: str, rpc_type: int, body: dict) -> dict:
+        conn = self._get_conn(target)
+        try:
+            conn.sock.sendall(bytes([rpc_type]))
+            conn.send_json(body)
+            rpc_error = conn.recv_json()
+            resp = conn.recv_json()
+        except (OSError, ValueError, TransportError) as exc:
+            conn.close()
+            raise TransportError(f"rpc to {target} failed: {exc}") from exc
+        if rpc_error:
+            conn.close()
+            raise TransportError(f"rpc error: {rpc_error}")
+        self._return_conn(target, conn)
+        return resp
+
+    # -- inbound -----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            sock.settimeout(None)
+            t = threading.Thread(target=self._handle_conn, args=(sock,), daemon=True)
+            t.start()
+
+    def _handle_conn(self, sock: socket.socket) -> None:
+        conn = _Conn(sock)
+        try:
+            while not self._shutdown.is_set():
+                t = conn.reader.read(1)
+                if not t:
+                    return
+                body = conn.recv_json()
+                if t[0] == RPC_SYNC:
+                    cmd = SyncRequest.from_dict(body)
+                elif t[0] == RPC_EAGER_SYNC:
+                    cmd = EagerSyncRequest.from_dict(body)
+                else:
+                    conn.send_json(f"unknown rpc type {t[0]}")
+                    conn.send_json({})
+                    continue
+
+                rpc = RPC(cmd)
+                self._consumer.put(rpc)
+                try:
+                    rpc_resp = rpc.resp_chan.get(timeout=self._timeout * 10)
+                except queue.Empty:
+                    conn.send_json("rpc handler timed out")
+                    conn.send_json({})
+                    continue
+                conn.send_json(str(rpc_resp.error) if rpc_resp.error else "")
+                payload = rpc_resp.response
+                conn.send_json(payload.to_dict() if payload is not None else {})
+        except (OSError, ValueError, TransportError):
+            pass
+        finally:
+            conn.close()

@@ -1,0 +1,122 @@
+"""Transport contract + RPC message types.
+
+Reference net/transport.go:6-57 and net/commands.go:5-27. Go's
+(out-param, error) convention becomes return-or-raise; Go channels
+become queue.Queue. The consumer queue carries inbound RPC objects the
+node answers via RPC.respond."""
+
+from __future__ import annotations
+
+import queue
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol
+
+from ..hashgraph.event import WireEvent
+
+
+class TransportError(Exception):
+    pass
+
+
+@dataclass
+class SyncRequest:
+    from_id: int
+    known: Dict[int, int]
+
+    def to_dict(self) -> dict:
+        return {"FromID": self.from_id, "Known": {str(k): v for k, v in self.known.items()}}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SyncRequest":
+        return cls(
+            from_id=d["FromID"],
+            known={int(k): v for k, v in (d.get("Known") or {}).items()},
+        )
+
+
+@dataclass
+class SyncResponse:
+    from_id: int
+    sync_limit: bool = False
+    events: List[WireEvent] = field(default_factory=list)
+    known: Dict[int, int] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "FromID": self.from_id,
+            "SyncLimit": self.sync_limit,
+            "Events": [e.to_dict() for e in self.events],
+            "Known": {str(k): v for k, v in self.known.items()},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SyncResponse":
+        return cls(
+            from_id=d["FromID"],
+            sync_limit=d.get("SyncLimit", False),
+            events=[WireEvent.from_json_obj(e) for e in (d.get("Events") or [])],
+            known={int(k): v for k, v in (d.get("Known") or {}).items()},
+        )
+
+
+@dataclass
+class EagerSyncRequest:
+    from_id: int
+    events: List[WireEvent] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "FromID": self.from_id,
+            "Events": [e.to_dict() for e in self.events],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "EagerSyncRequest":
+        return cls(
+            from_id=d["FromID"],
+            events=[WireEvent.from_json_obj(e) for e in (d.get("Events") or [])],
+        )
+
+
+@dataclass
+class EagerSyncResponse:
+    from_id: int
+    success: bool = False
+
+    def to_dict(self) -> dict:
+        return {"FromID": self.from_id, "Success": self.success}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "EagerSyncResponse":
+        return cls(from_id=d["FromID"], success=d.get("Success", False))
+
+
+@dataclass
+class RPCResponse:
+    response: object
+    error: Optional[Exception] = None
+
+
+class RPC:
+    """An inbound request plus its response channel."""
+
+    __slots__ = ("command", "resp_chan")
+
+    def __init__(self, command, resp_chan: Optional[queue.Queue] = None):
+        self.command = command
+        self.resp_chan = resp_chan if resp_chan is not None else queue.Queue(1)
+
+    def respond(self, resp, err: Optional[Exception] = None) -> None:
+        self.resp_chan.put(RPCResponse(resp, err))
+
+
+class Transport(Protocol):
+    def consumer(self) -> "queue.Queue[RPC]": ...
+
+    def local_addr(self) -> str: ...
+
+    def sync(self, target: str, args: SyncRequest) -> SyncResponse: ...
+
+    def eager_sync(self, target: str, args: EagerSyncRequest) -> EagerSyncResponse: ...
+
+    def close(self) -> None: ...

@@ -1,0 +1,20 @@
+"""The node runtime: per-node consensus facade (Core) and the gossip
+agent (Node) with its state machine, heartbeat timer, and peer
+selection — reference node/ package."""
+
+from .config import Config
+from .control_timer import ControlTimer
+from .core import Core
+from .node import Node
+from .peer_selector import PeerSelector, RandomPeerSelector
+from .state import NodeState
+
+__all__ = [
+    "Config",
+    "ControlTimer",
+    "Core",
+    "Node",
+    "NodeState",
+    "PeerSelector",
+    "RandomPeerSelector",
+]

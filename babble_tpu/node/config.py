@@ -1,0 +1,35 @@
+"""Node runtime configuration — reference node/config.go:12-61.
+
+Durations are seconds (floats) rather than Go time.Duration."""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+
+def _default_logger() -> logging.Logger:
+    return logging.getLogger("babble_tpu")
+
+
+@dataclass
+class Config:
+    heartbeat_timeout: float = 1.0
+    tcp_timeout: float = 1.0
+    cache_size: int = 500
+    sync_limit: int = 100
+    store_type: str = "inmem"  # "inmem" | "file"
+    store_path: str = ""
+    logger: logging.Logger = field(default_factory=_default_logger)
+
+
+def test_config(heartbeat: float = 0.005, cache_size: int = 10000) -> Config:
+    """Fast-heartbeat inmem config for tests — reference
+    node/config.go:56-61."""
+    return Config(
+        heartbeat_timeout=heartbeat,
+        tcp_timeout=0.5,
+        cache_size=cache_size,
+        sync_limit=1000,
+        store_type="inmem",
+    )

@@ -1,0 +1,184 @@
+"""Per-node consensus facade: owns the key and the hashgraph engine,
+tracks the head/sequence, computes sync diffs, and drives the consensus
+pipeline.
+
+Reference node/core.go:15-369."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from .. import crypto
+from ..hashgraph.block import Block
+from ..hashgraph.event import Event, WireEvent
+from ..hashgraph.graph import Hashgraph
+from ..hashgraph.store import Store
+
+
+class Core:
+    def __init__(
+        self,
+        id: int,
+        key,
+        participants: Dict[str, int],
+        store: Store,
+        commit_callback: Optional[Callable[[Block], None]] = None,
+    ):
+        self.id = id
+        self.key = key
+        self._pub_key: Optional[bytes] = None
+        self._hex_id: str = ""
+        self.hg = Hashgraph(participants, store, commit_callback)
+        self.participants = participants
+        self.reverse_participants = {pid: pk for pk, pid in participants.items()}
+        self.head = ""
+        self.seq = -1
+        self.transaction_pool: List[bytes] = []
+
+    def pub_key(self) -> bytes:
+        if self._pub_key is None:
+            self._pub_key = crypto.pub_key_bytes(self.key)
+        return self._pub_key
+
+    def hex_id(self) -> str:
+        if not self._hex_id:
+            self._hex_id = "0x" + self.pub_key().hex().upper()
+        return self._hex_id
+
+    def init(self) -> None:
+        """Create and insert the signed index-0 event — reference
+        node/core.go:80-86. Note the reference passes c.Seq (still 0)
+        and a nil payload."""
+        initial = Event.new(None, ["", ""], self.pub_key(), self.seq + 1)
+        self.sign_and_insert_self_event(initial)
+
+    def bootstrap(self) -> None:
+        """Replay a persistent store and recover head/seq — reference
+        node/core.go:88-120."""
+        self.hg.bootstrap()
+        last, is_root = self.hg.store.last_from(self.hex_id())
+        if is_root:
+            root = self.hg.store.get_root(self.hex_id())
+            self.head = root.x
+            self.seq = root.index
+        else:
+            last_event = self.hg.store.get_event(last)
+            self.head = last
+            self.seq = last_event.index()
+
+    def sign_and_insert_self_event(self, event: Event) -> None:
+        event.sign(self.key)
+        self.insert_event(event, True)
+
+    def insert_event(self, event: Event, set_wire_info: bool) -> None:
+        self.hg.insert_event(event, set_wire_info)
+        if event.creator() == self.hex_id():
+            self.head = event.hex()
+            self.seq = event.index()
+
+    def known(self) -> Dict[int, int]:
+        return self.hg.known()
+
+    def over_sync_limit(self, known: Dict[int, int], sync_limit: int) -> bool:
+        tot_unknown = 0
+        my_known = self.known()
+        for i, li in my_known.items():
+            if li > known.get(i, -1):
+                tot_unknown += li - known.get(i, -1)
+        return tot_unknown > sync_limit
+
+    def get_frame(self):
+        return self.hg.get_frame()
+
+    def diff(self, known: Dict[int, int]) -> List[Event]:
+        """Events we know that `known` doesn't, in topological order —
+        reference node/core.go:166-188."""
+        unknown: List[Event] = []
+        for pid, ct in known.items():
+            pk = self.reverse_participants[pid]
+            for ehex in self.hg.store.participant_events(pk, ct):
+                unknown.append(self.hg.store.get_event(ehex))
+        unknown.sort(key=lambda e: e.topological_index)
+        return unknown
+
+    def sync(self, unknown: List[WireEvent]) -> None:
+        """Insert synced events, then wrap the tx pool and the other
+        party's head in a new self-event — reference node/core.go:190-230."""
+        other_head = ""
+        for k, we in enumerate(unknown):
+            ev = self.hg.read_wire_info(we)
+            self.insert_event(ev, False)
+            if k == len(unknown) - 1:
+                other_head = ev.hex()
+
+        if len(unknown) > 0 or len(self.transaction_pool) > 0:
+            new_head = Event.new(
+                list(self.transaction_pool),
+                [self.head, other_head],
+                self.pub_key(),
+                self.seq + 1,
+            )
+            self.sign_and_insert_self_event(new_head)
+            self.transaction_pool = []
+
+    def add_self_event(self) -> None:
+        """Wrap a non-empty tx pool in a new self-event — reference
+        node/core.go:232-255."""
+        if not self.transaction_pool:
+            return
+        new_head = Event.new(
+            list(self.transaction_pool),
+            [self.head, ""],
+            self.pub_key(),
+            self.seq + 1,
+        )
+        self.sign_and_insert_self_event(new_head)
+        self.transaction_pool = []
+
+    def from_wire(self, wire_events: List[WireEvent]) -> List[Event]:
+        return [self.hg.read_wire_info(w) for w in wire_events]
+
+    def to_wire(self, events: List[Event]) -> List[WireEvent]:
+        return [e.to_wire() for e in events]
+
+    def run_consensus(self) -> None:
+        self.hg.run_consensus()
+
+    def add_transactions(self, txs: List[bytes]) -> None:
+        self.transaction_pool.extend(txs)
+
+    def get_head(self) -> Event:
+        return self.hg.store.get_event(self.head)
+
+    def get_event(self, hash_: str) -> Event:
+        return self.hg.store.get_event(hash_)
+
+    def get_consensus_events(self) -> List[str]:
+        return self.hg.consensus_events()
+
+    def get_consensus_events_count(self) -> int:
+        return self.hg.store.consensus_events_count()
+
+    def get_undetermined_events(self) -> List[str]:
+        return self.hg.undetermined_events
+
+    def get_pending_loaded_events(self) -> int:
+        return self.hg.pending_loaded_events
+
+    def get_consensus_transactions(self) -> List[bytes]:
+        txs: List[bytes] = []
+        for e in self.get_consensus_events():
+            txs.extend(self.get_event(e).transactions() or [])
+        return txs
+
+    def get_last_consensus_round_index(self) -> Optional[int]:
+        return self.hg.last_consensus_round
+
+    def get_consensus_transactions_count(self) -> int:
+        return self.hg.consensus_transactions
+
+    def get_last_commited_round_events_count(self) -> int:
+        return self.hg.last_commited_round_events
+
+    def need_gossip(self) -> bool:
+        return self.hg.pending_loaded_events > 0 or len(self.transaction_pool) > 0

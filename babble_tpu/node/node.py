@@ -1,0 +1,359 @@
+"""The gossip agent.
+
+Reference node/node.go. One Node owns a Core (guarded by core_lock), a
+transport, an app proxy, the heartbeat ControlTimer, and the state
+machine {Babbling, CatchingUp, Shutdown}. Gossip is pull-push: on each
+heartbeat pick a random peer, pull (SyncRequest with our known map,
+insert their diff, wrap in a new self-event, run consensus), then push
+(EagerSyncRequest with their diff). Inbound RPCs, submitted
+transactions, and committed blocks are serviced by a background worker.
+
+Go's 4-way channel select (node.go:135-159) becomes forwarder threads
+multiplexing onto one work queue.
+
+Divergence from the reference (improvement): syncRequests/syncErrors
+are actually incremented, so the sync_rate stat is live (the reference
+declares the counters but never updates them — node/node.go:46-47,575)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..hashgraph.block import Block
+from ..hashgraph.store import Store
+from ..net.peer import Peer
+from ..net.transport import (
+    EagerSyncRequest,
+    EagerSyncResponse,
+    RPC,
+    SyncRequest,
+    SyncResponse,
+    Transport,
+    TransportError,
+)
+from ..proxy.proxy import AppProxy
+from .config import Config
+from .control_timer import ControlTimer
+from .core import Core
+from .peer_selector import RandomPeerSelector
+from .state import NodeState, StateMachine
+
+
+class Node:
+    def __init__(
+        self,
+        conf: Config,
+        id: int,
+        key,
+        participants: List[Peer],
+        store: Store,
+        trans: Transport,
+        proxy: AppProxy,
+    ):
+        self.conf = conf
+        self.id = id
+        self.logger = conf.logger
+        self.local_addr = trans.local_addr()
+
+        self.commit_ch: "queue.Queue[Block]" = queue.Queue(400)
+        pmap = store.participants()
+        self.core = Core(id, key, pmap, store, commit_callback=self.commit_ch.put)
+        self.core_lock = threading.Lock()
+
+        self.peer_selector = RandomPeerSelector(participants, self.local_addr)
+        self.selector_lock = threading.Lock()
+
+        self.trans = trans
+        self.net_ch = trans.consumer()
+        self.proxy = proxy
+        self.submit_ch = proxy.submit_ch()
+
+        self.state = StateMachine()
+        self.state.set_starting(True)
+
+        self.control_timer = ControlTimer(conf.heartbeat_timeout)
+        self._work: "queue.Queue[tuple]" = queue.Queue()
+        self._shutdown = threading.Event()
+
+        self.start_time = time.monotonic()
+        self.sync_requests = 0
+        self.sync_errors = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def init(self, bootstrap: bool = False) -> None:
+        if bootstrap:
+            self.core.bootstrap()
+        else:
+            self.core.init()
+
+    def run_async(self, gossip: bool = True) -> threading.Thread:
+        t = threading.Thread(target=self.run, args=(gossip,), daemon=True)
+        t.start()
+        return t
+
+    def run(self, gossip: bool = True) -> None:
+        self.start_time = time.monotonic()
+        self.control_timer.run()
+        self._start_forwarders()
+        self.state.go_func(self._do_background_work)
+
+        while True:
+            state = self.state.get_state()
+            if state == NodeState.BABBLING:
+                self._babble(gossip)
+            elif state == NodeState.CATCHING_UP:
+                self._fast_forward()
+            elif state == NodeState.SHUTDOWN:
+                return
+
+    def shutdown(self) -> None:
+        if self.state.get_state() == NodeState.SHUTDOWN:
+            return
+        self.state.set_state(NodeState.SHUTDOWN)
+        self._shutdown.set()
+        self._work.put(("shutdown", None))
+        self.control_timer.shutdown()
+        self.state.wait_routines(timeout=2.0)
+        self.trans.close()
+        self.core.hg.store.close()
+
+    # -- background work ---------------------------------------------------
+
+    def _start_forwarders(self) -> None:
+        def forward(src: queue.Queue, tag: str) -> None:
+            while not self._shutdown.is_set():
+                try:
+                    item = src.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                self._work.put((tag, item))
+
+        self.state.go_func(lambda: forward(self.net_ch, "rpc"))
+        self.state.go_func(lambda: forward(self.submit_ch, "tx"))
+        self.state.go_func(lambda: forward(self.commit_ch, "block"))
+
+    def _do_background_work(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                tag, item = self._work.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if tag == "rpc":
+                self._process_rpc(item)
+                if self.core.need_gossip() and not self.control_timer.set:
+                    self.control_timer.reset()
+            elif tag == "tx":
+                self._add_transaction(item)
+                if not self.control_timer.set:
+                    self.control_timer.reset()
+            elif tag == "block":
+                try:
+                    self._commit(item)
+                except Exception as exc:  # noqa: BLE001 - keep the loop alive
+                    self.logger.error("commit failed: %s", exc)
+            elif tag == "shutdown":
+                return
+
+    # -- the babbling loop -------------------------------------------------
+
+    def _babble(self, gossip: bool) -> None:
+        while True:
+            old_state = self.state.get_state()
+            try:
+                self.control_timer.tick_ch.get(timeout=0.1)
+                ticked = True
+            except queue.Empty:
+                ticked = False
+
+            if ticked:
+                if gossip:
+                    proceed = self._pre_gossip()
+                    peer = self.peer_selector.next() if proceed else None
+                    if peer is not None:
+                        addr = peer.net_addr
+                        self.state.go_func(lambda: self._gossip(addr))
+                if not self.core.need_gossip():
+                    self.control_timer.stop()
+                elif not self.control_timer.set:
+                    self.control_timer.reset()
+
+            if self._shutdown.is_set():
+                return
+            if self.state.get_state() != old_state:
+                return
+
+    def _pre_gossip(self) -> bool:
+        with self.core_lock:
+            need = self.core.need_gossip() or self.state.is_starting()
+            if not need:
+                return False
+            try:
+                self.core.add_self_event()
+            except Exception as exc:  # noqa: BLE001
+                self.logger.error("adding self event: %s", exc)
+                return False
+            return True
+
+    def _gossip(self, peer_addr: str) -> None:
+        try:
+            sync_limit, other_known = self._pull(peer_addr)
+        except TransportError as exc:
+            self.logger.debug("pull from %s failed: %s", peer_addr, exc)
+            return
+        except Exception as exc:  # noqa: BLE001
+            self.logger.error("pull from %s failed: %s", peer_addr, exc)
+            return
+
+        if sync_limit:
+            self.state.set_state(NodeState.CATCHING_UP)
+            return
+
+        try:
+            self._push(peer_addr, other_known)
+        except Exception as exc:  # noqa: BLE001
+            self.logger.debug("push to %s failed: %s", peer_addr, exc)
+            return
+
+        with self.selector_lock:
+            self.peer_selector.update_last(peer_addr)
+        self.state.set_starting(False)
+
+    def _pull(self, peer_addr: str):
+        with self.core_lock:
+            known = self.core.known()
+
+        self.sync_requests += 1
+        try:
+            resp = self.trans.sync(peer_addr, SyncRequest(self.id, known))
+        except Exception:
+            self.sync_errors += 1
+            raise
+
+        if resp.sync_limit:
+            return True, None
+
+        with self.core_lock:
+            self._sync(resp.events)
+        return False, resp.known
+
+    def _push(self, peer_addr: str, known: Dict[int, int]) -> None:
+        with self.core_lock:
+            if self.core.over_sync_limit(known, self.conf.sync_limit):
+                return
+            diff = self.core.diff(known)
+            wire_events = self.core.to_wire(diff)
+
+        self.sync_requests += 1
+        try:
+            self.trans.eager_sync(peer_addr, EagerSyncRequest(self.id, wire_events))
+        except Exception:
+            self.sync_errors += 1
+            raise
+
+    def _sync(self, events) -> None:
+        """Insert synced events + run consensus (caller holds core_lock)
+        — reference node/node.go:467-487."""
+        self.core.sync(events)
+        self.core.run_consensus()
+
+    def _fast_forward(self) -> None:
+        # Reference stub (node/node.go:432-441): fast-sync from a Frame
+        # is unfinished upstream; drop straight back to Babbling.
+        self.state.set_state(NodeState.BABBLING)
+
+    # -- RPC serving -------------------------------------------------------
+
+    def _process_rpc(self, rpc: RPC) -> None:
+        state = self.state.get_state()
+        if state != NodeState.BABBLING:
+            rpc.respond(SyncResponse(self.id), TransportError(f"not ready: {state}"))
+            return
+        cmd = rpc.command
+        if isinstance(cmd, SyncRequest):
+            self._process_sync_request(rpc, cmd)
+        elif isinstance(cmd, EagerSyncRequest):
+            self._process_eager_sync_request(rpc, cmd)
+        else:
+            rpc.respond(None, TransportError("unexpected command"))
+
+    def _process_sync_request(self, rpc: RPC, cmd: SyncRequest) -> None:
+        resp = SyncResponse(self.id)
+        resp_err: Optional[Exception] = None
+        with self.core_lock:
+            over_limit = self.core.over_sync_limit(cmd.known, self.conf.sync_limit)
+        if over_limit:
+            resp.sync_limit = True
+        else:
+            try:
+                with self.core_lock:
+                    diff = self.core.diff(cmd.known)
+                resp.events = self.core.to_wire(diff)
+            except Exception as exc:  # noqa: BLE001
+                resp_err = exc
+        with self.core_lock:
+            resp.known = self.core.known()
+        rpc.respond(resp, resp_err)
+
+    def _process_eager_sync_request(self, rpc: RPC, cmd: EagerSyncRequest) -> None:
+        success = True
+        err: Optional[Exception] = None
+        with self.core_lock:
+            try:
+                self._sync(cmd.events)
+            except Exception as exc:  # noqa: BLE001
+                success = False
+                err = exc
+        rpc.respond(EagerSyncResponse(self.id, success), err)
+
+    # -- app side ----------------------------------------------------------
+
+    def _commit(self, block: Block) -> None:
+        self.proxy.commit_block(block)
+
+    def _add_transaction(self, tx: bytes) -> None:
+        with self.core_lock:
+            self.core.add_transactions([tx])
+
+    def submit_tx(self, tx: bytes) -> None:
+        """Convenience for in-process callers (tests, demos)."""
+        self.submit_ch.put(tx)
+
+    # -- observability -----------------------------------------------------
+
+    def get_stats(self) -> Dict[str, str]:
+        elapsed = time.monotonic() - self.start_time
+        consensus_events = self.core.get_consensus_events_count()
+        events_per_second = consensus_events / elapsed if elapsed > 0 else 0.0
+        last_consensus_round = self.core.get_last_consensus_round_index()
+        rounds_per_second = (
+            last_consensus_round / elapsed
+            if last_consensus_round is not None and elapsed > 0
+            else 0.0
+        )
+        return {
+            "last_consensus_round": (
+                "nil" if last_consensus_round is None else str(last_consensus_round)
+            ),
+            "consensus_events": str(consensus_events),
+            "consensus_transactions": str(
+                self.core.get_consensus_transactions_count()
+            ),
+            "undetermined_events": str(len(self.core.get_undetermined_events())),
+            "transaction_pool": str(len(self.core.transaction_pool)),
+            "num_peers": str(len(self.peer_selector.peers())),
+            "sync_rate": f"{self.sync_rate():.2f}",
+            "events_per_second": f"{events_per_second:.2f}",
+            "rounds_per_second": f"{rounds_per_second:.2f}",
+            "round_events": str(self.core.get_last_commited_round_events_count()),
+            "id": str(self.id),
+            "state": str(self.state.get_state()),
+        }
+
+    def sync_rate(self) -> float:
+        if self.sync_requests == 0:
+            return 1.0
+        return 1.0 - self.sync_errors / self.sync_requests

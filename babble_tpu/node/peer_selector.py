@@ -1,0 +1,42 @@
+"""Gossip partner selection — reference node/peer_selector.go:9-46.
+
+The pluggable seam for alternative topologies (the batched simulation's
+schedule tensor plays this role on device)."""
+
+from __future__ import annotations
+
+import random
+from typing import List, Protocol
+
+from ..net.peer import Peer, exclude_peer
+
+
+class PeerSelector(Protocol):
+    def peers(self) -> List[Peer]: ...
+
+    def update_last(self, peer_addr: str) -> None: ...
+
+    def next(self) -> Peer: ...
+
+
+class RandomPeerSelector:
+    """Uniform random over peers, excluding self and the last-gossiped
+    peer when there is a choice."""
+
+    def __init__(self, participants: List[Peer], local_addr: str):
+        _, self._peers = exclude_peer(participants, local_addr)
+        self._last = ""
+
+    def peers(self) -> List[Peer]:
+        return self._peers
+
+    def update_last(self, peer_addr: str) -> None:
+        self._last = peer_addr
+
+    def next(self) -> Peer | None:
+        selectable = self._peers
+        if not selectable:
+            return None  # single-node net: nobody to gossip with
+        if len(selectable) > 1:
+            _, selectable = exclude_peer(selectable, self._last)
+        return random.choice(selectable)

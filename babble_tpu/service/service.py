@@ -1,0 +1,64 @@
+"""GET /Stats -> JSON of the node's live counters, with permissive CORS
+— reference service/service.go:17-65."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class Service:
+    def __init__(self, bind_addr: str, node):
+        host, port_s = bind_addr.rsplit(":", 1)
+        self.node = node
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib API
+                if self.path.rstrip("/") in ("/Stats", "/stats", ""):
+                    body = json.dumps(service.node.get_stats()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Access-Control-Allow-Origin", "*")
+                    self.send_header(
+                        "Access-Control-Allow-Methods", "POST, GET, OPTIONS, PUT, DELETE"
+                    )
+                    self.send_header(
+                        "Access-Control-Allow-Headers",
+                        "Accept, Content-Type, Content-Length, Accept-Encoding, "
+                        "X-CSRF-Token, Authorization",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def do_OPTIONS(self):  # noqa: N802 - CORS preflight
+                self.send_response(200)
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.send_header(
+                    "Access-Control-Allow-Methods", "POST, GET, OPTIONS, PUT, DELETE"
+                )
+                self.end_headers()
+
+            def log_message(self, fmt, *args):  # silence per-request noise
+                pass
+
+        self._server = ThreadingHTTPServer((host, int(port_s)), Handler)
+        self.addr = f"{host}:{self._server.server_address[1]}"
+        self._thread: threading.Thread | None = None
+
+    def serve(self) -> None:
+        """Blocking serve — reference Service.Serve."""
+        self._server.serve_forever(poll_interval=0.1)
+
+    def serve_async(self) -> None:
+        self._thread = threading.Thread(target=self.serve, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

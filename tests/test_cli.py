@@ -1,0 +1,152 @@
+"""CLI end-to-end: keygen/version, and a real localhost testnet
+launched purely through `python -m babble_tpu.cli run` subprocesses
+with dummy chat clients submitting transactions — the demo testnet in
+miniature (reference cmd/babble/main.go + demo/)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(*args, **kw):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "babble_tpu.cli", *args],
+        capture_output=True, text=True, timeout=60, env=env, **kw,
+    )
+
+
+def test_version():
+    out = run_cli("version")
+    assert out.returncode == 0
+    assert out.stdout.strip()
+
+
+def test_keygen(tmp_path):
+    datadir = str(tmp_path / "keys")
+    out = run_cli("keygen", "--datadir", datadir)
+    assert out.returncode == 0
+    assert "PublicKey: 0x" in out.stdout
+    pem = open(os.path.join(datadir, "priv_key.pem")).read()
+    assert "EC PRIVATE KEY" in pem
+
+    # keygen without datadir prints the key
+    out2 = run_cli("keygen")
+    assert "PRIVATE KEY" in out2.stdout
+
+
+@pytest.mark.slow
+def test_cli_testnet(tmp_path):
+    from babble_tpu.dummy import DummyClient
+
+    n = 3
+    base_port = 21700 + (os.getpid() % 500) * 10
+    datadirs, pubs = [], []
+    for i in range(n):
+        d = str(tmp_path / f"node{i}")
+        out = run_cli("keygen", "--datadir", d)
+        assert out.returncode == 0
+        pubs.append(out.stdout.split("PublicKey: ")[1].split()[0])
+        datadirs.append(d)
+
+    peers = [
+        {"NetAddr": f"127.0.0.1:{base_port + i * 3}", "PubKeyHex": pubs[i]}
+        for i in range(n)
+    ]
+    for d in datadirs:
+        with open(os.path.join(d, "peers.json"), "w") as f:
+            json.dump(peers, f)
+
+    procs, clients = [], []
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        for i in range(n):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "babble_tpu.cli", "run",
+                 "--datadir", datadirs[i],
+                 "--node_addr", f"127.0.0.1:{base_port + i * 3}",
+                 "--proxy_addr", f"127.0.0.1:{base_port + i * 3 + 1}",
+                 "--client_addr", f"127.0.0.1:{base_port + i * 3 + 2}",
+                 "--service_addr", f"127.0.0.1:{base_port + 1000 + i}",
+                 "--heartbeat", "50", "--log_level", "error"],
+                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+            ))
+        # wait for the app-proxy servers to come up, then attach clients
+        import socket
+
+        def wait_port(port, deadline):
+            while time.monotonic() < deadline:
+                s = socket.socket()
+                s.settimeout(0.5)
+                try:
+                    s.connect(("127.0.0.1", port))
+                    return True
+                except OSError:
+                    time.sleep(0.2)
+                finally:
+                    s.close()
+            return False
+
+        boot_deadline = time.monotonic() + 30
+        for i in range(n):
+            port_up = wait_port(base_port + i * 3 + 1, boot_deadline)
+            assert procs[i].poll() is None and port_up, (
+                f"node {i} not up: {procs[i].stderr.read()[-2000:] if procs[i].poll() is not None else 'port closed'}"
+            )
+            clients.append(DummyClient(
+                f"127.0.0.1:{base_port + i * 3 + 1}",
+                f"127.0.0.1:{base_port + i * 3 + 2}",
+            ))
+
+        # chat: each client submits messages until consensus advances
+        deadline = time.monotonic() + 90
+        committed = []
+        k = 0
+        while time.monotonic() < deadline:
+            try:
+                clients[k % n].submit_tx(f"client{k % n}: msg {k}".encode())
+            except OSError:
+                pass  # node still warming up; retry next tick
+            k += 1
+            committed = clients[0].state.get_committed_transactions()
+            if len(committed) >= 5:
+                break
+            time.sleep(0.05)
+        assert len(committed) >= 5, "testnet never committed transactions"
+
+        # all clients converge on the same committed prefix
+        time.sleep(1.0)
+        logs = [c.state.get_committed_transactions() for c in clients]
+        m = min(len(log) for log in logs)
+        assert m > 0
+        for log in logs[1:]:
+            assert log[:m] == logs[0][:m]
+
+        # /Stats serves live counters
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{base_port + 1000}/Stats", timeout=3
+        ) as r:
+            stats = json.loads(r.read())
+        assert stats["state"] == "Babbling"
+        assert int(stats["consensus_transactions"]) > 0
+    finally:
+        for c in clients:
+            c.close()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -1,0 +1,259 @@
+"""Node runtime tests: core-pair syncs (reference node/core_test.go) and
+multi-node gossip with checkGossip prefix equality (reference
+node/node_test.go:396-599), over both the inmem and TCP transports."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from babble_tpu import crypto
+from babble_tpu.hashgraph import InmemStore
+from babble_tpu.net import InmemTransport, Peer, TCPTransport
+from babble_tpu.net.inmem_transport import connect_all
+from babble_tpu.node import Core, Node
+from babble_tpu.node.config import test_config as fast_config
+from babble_tpu.proxy import InmemAppProxy
+
+CACHE = 10000
+
+
+def make_keyed_peers(n, seed_base=5000, addr_fn=None):
+    keys = [crypto.key_from_seed(seed_base + i) for i in range(n)]
+    entries = []
+    for i, k in enumerate(keys):
+        pub_hex = "0x" + crypto.pub_key_bytes(k).hex().upper()
+        addr = addr_fn(i) if addr_fn else f"peer{i}"
+        entries.append((k, Peer(addr, pub_hex)))
+    # canonical id assignment: sorted pubkey order (cmd/babble/main.go:215-225)
+    entries.sort(key=lambda e: e[1].pub_key_hex)
+    return entries
+
+
+def init_cores(n):
+    entries = make_keyed_peers(n)
+    participants = {p.pub_key_hex: i for i, (_, p) in enumerate(entries)}
+    cores = []
+    for i, (key, _) in enumerate(entries):
+        core = Core(i, key, participants, InmemStore(participants, CACHE))
+        core.init()
+        cores.append(core)
+    return cores
+
+
+def synchronize_cores(cores, frm, to, payload=()):
+    known_by_to = cores[to].known()
+    unknown = cores[frm].diff(known_by_to)
+    wire = cores[frm].to_wire(unknown)
+    cores[to].add_transactions(list(payload))
+    cores[to].sync(wire)
+
+
+def sync_and_run_consensus(cores, frm, to, payload=()):
+    synchronize_cores(cores, frm, to, payload)
+    cores[to].run_consensus()
+
+
+# ---------------------------------------------------------------- cores
+
+
+def test_core_init_heads():
+    cores = init_cores(3)
+    for c in cores:
+        assert c.seq == 0
+        assert c.head != ""
+        head = c.get_head()
+        assert head.creator() == c.hex_id()
+
+
+def test_core_sync_pair():
+    cores = init_cores(2)
+    # 0 -> 1: 1 learns 0's initial event and creates a new head
+    synchronize_cores(cores, 0, 1, [b"hello"])
+    assert cores[1].seq == 1
+    known = cores[1].known()
+    assert sorted(known.values()) == [0, 1]
+    # back: 0 learns 1's two events
+    synchronize_cores(cores, 1, 0)
+    assert cores[0].seq == 1
+    assert all(v == 1 for v in cores[0].known().values())
+
+
+def test_core_consensus_identical_order():
+    """Scripted gossip between 3 cores converges to identical consensus
+    order — reference core_test.go TestConsensus:354."""
+    cores = init_cores(3)
+    playbook = [
+        (0, 1, [b"tx one"]),
+        (1, 2, []),
+        (2, 0, [b"tx two"]),
+        (0, 1, []),
+        (1, 2, [b"tx three"]),
+        (2, 0, []),
+        (0, 1, [b"tx four"]),
+        (1, 2, []),
+        (2, 0, []),
+        (0, 1, []),
+        (1, 2, []),
+        (2, 0, []),
+    ]
+    for frm, to, payload in playbook:
+        sync_and_run_consensus(cores, frm, to, payload)
+
+    lens = [len(c.get_consensus_events()) for c in cores]
+    assert max(lens) > 0, "no consensus reached"
+    ref = cores[0].get_consensus_events()
+    for c in cores[1:]:
+        other = c.get_consensus_events()
+        m = min(len(ref), len(other))
+        assert ref[:m] == other[:m]
+
+
+def test_core_over_sync_limit():
+    cores = init_cores(2)
+    for _ in range(5):
+        synchronize_cores(cores, 0, 1, [b"x"])
+        synchronize_cores(cores, 1, 0)
+    known_zero = {i: -1 for i in cores[0].known()}
+    assert cores[0].over_sync_limit(known_zero, 5)
+    assert not cores[0].over_sync_limit(cores[0].known(), 5)
+
+
+# ---------------------------------------------------------------- nodes
+
+
+def make_nodes(n, transport):
+    if transport == "tcp":
+        transports = [
+            TCPTransport("127.0.0.1:0", timeout=2.0) for _ in range(n)
+        ]
+        addrs = [t.local_addr() for t in transports]
+        entries = make_keyed_peers(n, addr_fn=lambda i: addrs[i])
+    else:
+        transports = [InmemTransport(f"addr{i}", timeout=2.0) for i in range(n)]
+        connect_all(transports)
+        entries = make_keyed_peers(n, addr_fn=lambda i: f"addr{i}")
+
+    # transports were created in creation order; map them to sorted order
+    by_addr = {t.local_addr(): t for t in transports}
+    peers = [p for _, p in entries]
+    participants = {p.pub_key_hex: i for i, p in enumerate(peers)}
+
+    nodes = []
+    for i, (key, peer) in enumerate(entries):
+        conf = fast_config(heartbeat=0.01 if transport == "inmem" else 0.05)
+        store = InmemStore(participants, CACHE)
+        proxy = InmemAppProxy()
+        node = Node(conf, i, key, peers, store, by_addr[peer.net_addr], proxy)
+        node.init()
+        nodes.append(node)
+    return nodes
+
+
+def run_gossip(nodes, target_round, timeout=60.0):
+    """Run all nodes and bombard them with transactions until every
+    node reaches target_round — the reference's gossip/bombardAndWait
+    driver (node_test.go:507-545,601-617). Continuous submission
+    matters: nodes go quiescent by design when nothing is pending."""
+    for node in nodes:
+        node.run_async(gossip=True)
+    submitted = []
+    deadline = time.monotonic() + timeout
+    i = 0
+    try:
+        while time.monotonic() < deadline:
+            tx = f"node{i % len(nodes)} transaction {i}".encode()
+            nodes[i % len(nodes)].submit_tx(tx)
+            submitted.append(tx)
+            i += 1
+            done = all(
+                (n.core.get_last_consensus_round_index() or 0) >= target_round
+                for n in nodes
+            )
+            if done:
+                return submitted
+            time.sleep(0.02)
+        rounds = [n.core.get_last_consensus_round_index() for n in nodes]
+        raise AssertionError(f"timeout: consensus rounds {rounds} < {target_round}")
+    finally:
+        for node in nodes:
+            node.shutdown()
+
+
+def check_gossip(nodes):
+    cons_events = {n.id: n.core.get_consensus_events() for n in nodes}
+    cons_txs = {n.id: n.core.get_consensus_transactions() for n in nodes}
+
+    min_e = min(len(v) for v in cons_events.values())
+    min_t = min(len(v) for v in cons_txs.values())
+    assert min_e > 0, "no consensus events"
+
+    ref_e = cons_events[nodes[0].id]
+    ref_t = cons_txs[nodes[0].id]
+    for n in nodes[1:]:
+        assert cons_events[n.id][:min_e] == ref_e[:min_e], (
+            f"consensus event mismatch vs node {n.id}"
+        )
+        assert cons_txs[n.id][:min_t] == ref_t[:min_t], (
+            f"consensus tx mismatch vs node {n.id}"
+        )
+
+
+@pytest.mark.parametrize("transport", ["inmem", "tcp"])
+def test_gossip(transport):
+    nodes = make_nodes(4, transport)
+    run_gossip(nodes, target_round=10)
+    check_gossip(nodes)
+
+
+def test_missing_node_gossip():
+    """Gossip converges even when one node never participates —
+    reference node_test.go:409-420."""
+    nodes = make_nodes(4, "inmem")
+    try:
+        for node in nodes[1:]:
+            node.run_async(gossip=True)
+        deadline = time.monotonic() + 60.0
+        i = 0
+        while time.monotonic() < deadline:
+            nodes[1 + i % 3].submit_tx(f"tx {i}".encode())
+            i += 1
+            if all(
+                (n.core.get_last_consensus_round_index() or 0) >= 5
+                for n in nodes[1:]
+            ):
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("timeout")
+    finally:
+        for node in nodes:
+            node.shutdown()
+    check_gossip(nodes[1:])
+
+
+def test_stats():
+    nodes = make_nodes(4, "inmem")
+    run_gossip(nodes, target_round=3)
+    stats = nodes[0].get_stats()
+    assert set(stats) == {
+        "last_consensus_round", "consensus_events", "consensus_transactions",
+        "undetermined_events", "transaction_pool", "num_peers", "sync_rate",
+        "events_per_second", "rounds_per_second", "round_events", "id", "state",
+    }
+    assert int(stats["last_consensus_round"]) >= 3
+    assert int(stats["num_peers"]) == 3
+    assert float(stats["events_per_second"]) > 0
+
+
+def test_committed_transactions_reach_proxy():
+    nodes = make_nodes(4, "inmem")
+    submitted = run_gossip(nodes, target_round=8)
+    # every node's app proxy saw a prefix-consistent committed tx stream
+    time.sleep(0.2)
+    committed = [n.proxy.committed_transactions() for n in nodes]
+    assert any(len(c) > 0 for c in committed), "nothing committed to apps"
+    for c in committed:
+        for tx in c:
+            assert tx in submitted

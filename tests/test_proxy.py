@@ -1,0 +1,70 @@
+"""Socket proxy pair round-trips — reference proxy/socket_proxy_test.go:
+SubmitTx flows app -> babble, CommitBlock flows babble -> app."""
+
+from __future__ import annotations
+
+import queue
+
+from babble_tpu.hashgraph.block import Block
+from babble_tpu.proxy import SocketAppProxy, SocketBabbleProxy
+
+
+def test_socket_proxy_roundtrip():
+    # babble side binds first on an ephemeral port
+    app_proxy = SocketAppProxy("127.0.0.1:0", "127.0.0.1:0", timeout=1.0)
+    # app side: point at the babble proxy server; bind our own server
+    babble_proxy = SocketBabbleProxy(app_proxy.bind_addr, "127.0.0.1:0", timeout=1.0)
+    # now tell the app proxy where the app's server actually is
+    app_proxy.set_client_addr(babble_proxy.bind_addr)
+
+    try:
+        # app -> babble
+        tx = b"the test transaction"
+        babble_proxy.submit_tx(tx)
+        got = app_proxy.submit_ch().get(timeout=1.0)
+        assert got == tx
+
+        # babble -> app
+        block = Block(7, [b"tx one", b"tx two"])
+        app_proxy.commit_block(block)
+        got_block = babble_proxy.commit_ch().get(timeout=1.0)
+        assert got_block.round_received == 7
+        assert got_block.transactions == [b"tx one", b"tx two"]
+        assert got_block.hash() == block.hash()
+
+        # nil transactions survive (Go nil-slice -> null)
+        app_proxy.commit_block(Block(8, None))
+        got_nil = babble_proxy.commit_ch().get(timeout=1.0)
+        assert got_nil.transactions is None
+    finally:
+        app_proxy.close()
+        babble_proxy.close()
+
+
+def test_dummy_client_commit_log(tmp_path):
+    from babble_tpu.dummy import DummyClient
+
+    app_proxy = SocketAppProxy("127.0.0.1:0", "127.0.0.1:0", timeout=1.0)
+    log = str(tmp_path / "messages.txt")
+    client = DummyClient(app_proxy.bind_addr, "127.0.0.1:0", log_path=log)
+    app_proxy.set_client_addr(client.proxy.bind_addr)
+
+    try:
+        client.submit_tx(b"client1: hello")
+        assert app_proxy.submit_ch().get(timeout=1.0) == b"client1: hello"
+
+        app_proxy.commit_block(Block(0, [b"client1: hello", b"client2: hi"]))
+        deadline = 50
+        while len(client.state.get_committed_transactions()) < 2 and deadline:
+            import time
+
+            time.sleep(0.02)
+            deadline -= 1
+        assert client.state.get_committed_transactions() == [
+            "client1: hello", "client2: hi",
+        ]
+        with open(log) as f:
+            assert f.read() == "client1: hello\nclient2: hi\n"
+    finally:
+        client.close()
+        app_proxy.close()

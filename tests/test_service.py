@@ -1,0 +1,29 @@
+"""HTTP /Stats service — reference service/service.go: live JSON stats
+with CORS from a running node."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from babble_tpu.service import Service
+
+from test_node import check_gossip, make_nodes, run_gossip
+
+
+def test_stats_endpoint():
+    nodes = make_nodes(4, "inmem")
+    service = Service("127.0.0.1:0", nodes[0])
+    service.serve_async()
+    try:
+        run_gossip(nodes, target_round=3)
+        with urllib.request.urlopen(f"http://{service.addr}/Stats", timeout=2) as r:
+            assert r.status == 200
+            assert r.headers["Access-Control-Allow-Origin"] == "*"
+            stats = json.loads(r.read())
+        assert int(stats["last_consensus_round"]) >= 3
+        assert stats["id"] == "0" or stats["id"].isdigit()
+        assert float(stats["events_per_second"]) > 0
+        check_gossip(nodes)
+    finally:
+        service.close()
