@@ -39,6 +39,7 @@ class DagTensors:
     ts_rank: np.ndarray  # [E+1] int32 dense timestamp rank
     ts_values: np.ndarray  # [U] int64 sorted unique timestamp ns
     levels: np.ndarray  # [L, W] int32 event ids per DAG depth level, -1 pad
+    depth: int  # true DAG depth (pre-chunking level count)
     chain: np.ndarray  # [n, K] int32 event id of creator c's k-th event, -1 pad
     chain_len: np.ndarray  # [n] int32
     chain_rank: np.ndarray  # [n, K] int32 timestamp rank along each chain
@@ -54,10 +55,12 @@ class DagTensors:
     @property
     def max_rounds(self) -> int:
         """Static bound on round numbers: rounds start from the largest
-        Root round (-1 for base roots) and grow by at most 1 per DAG
-        depth level (round(x) <= max(parent rounds) + 1)."""
+        Root round (-1 for base roots) and grow by at most 1 per true
+        DAG depth level (round(x) <= max(parent rounds) + 1). Uses the
+        pre-chunking depth — chunked level rows subdivide levels
+        without adding round headroom."""
         base = int(self.root_round.max()) + 1 if self.n else 0
-        return max(base, 0) + int(self.levels.shape[0]) + 2
+        return max(base, 0) + self.depth + 2
 
 
 def _assemble(
@@ -92,6 +95,7 @@ def _assemble(
             lv = max(lv, level[op])
         level[i] = lv + 1
     n_levels = int(level.max()) + 1 if e else 1
+    depth = n_levels
     buckets: List[List[int]] = [[] for _ in range(n_levels)]
     for i in range(e):
         buckets[level[i]].append(i)
@@ -138,6 +142,7 @@ def _assemble(
         ts_rank=ts_rank,
         ts_values=ts_values,
         levels=levels,
+        depth=depth,
         chain=chain,
         chain_len=chain_len,
         chain_rank=chain_rank,

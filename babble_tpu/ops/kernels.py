@@ -103,7 +103,19 @@ def compute_first_descendants(la, creator, index, chain, chain_len, *, n):
 
 @functools.partial(jax.jit, static_argnames=("n", "sm", "r"))
 def compute_rounds(
-    self_parent, other_parent, creator, index, la, fd, levels, root_round, *, n, sm, r
+    self_parent,
+    other_parent,
+    creator,
+    index,
+    la,
+    fd,
+    levels,
+    root_round,
+    valid_mask=None,
+    *,
+    n,
+    sm,
+    r,
 ):
     """Round numbers, witness flags, and the witness table — reference
     DivideRounds / Round / RoundInc / Witness (hashgraph.go:211-339,
@@ -115,9 +127,19 @@ def compute_rounds(
     most one witness per round) — [W, n, n] compares per level instead
     of anything E x E.
 
+    `valid_mask` [E+1] restricts consensus to an ancestry-closed
+    subgraph (a simulated peer's partial view): coordinates computed on
+    the full DAG stay exact for any closed view (descendants along a
+    creator chain form a suffix, so la[x] >= fd[w] agrees with the
+    view-local comparison for every valid x), leaving the witness table
+    as the only place masking is required. This is what makes the
+    per-peer batched simulation one vmap over masks.
+
     Returns (rounds[E], witness[E] bool, wt[r, n] event ids, -1 empty).
     """
     e = la.shape[0]
+    if valid_mask is None:
+        valid_mask = jnp.ones((e + 1,), dtype=jnp.bool_)
     la_p = jnp.concatenate([la, jnp.full((1, n), -1, jnp.int32)], axis=0)
     rounds = jnp.full((e + 1,), -1, dtype=jnp.int32)
     wit = jnp.zeros((e + 1,), dtype=jnp.bool_)
@@ -153,7 +175,7 @@ def compute_rounds(
         w_new = ((sp < 0) & (op < 0)) | (r_new > rnd_sp_raw)
         rounds = rounds.at[sids].set(jnp.where(valid, r_new, -1))
         wit = wit.at[sids].set(jnp.where(valid, w_new, False))
-        upd = valid & w_new
+        upd = valid & w_new & valid_mask[sids]
         r_idx = jnp.where(upd, jnp.clip(r_new, 0, r - 1), r)
         wt = wt.at[r_idx, cr].set(jnp.where(upd, sids, -1))
         return rounds, wit, wt
@@ -237,7 +259,7 @@ def decide_fame(wt, la, fd, index, coin, *, n, sm, r):
 
 @functools.partial(jax.jit, static_argnames=("n", "r"))
 def decide_round_received(
-    rounds, wt, famous, la, fd, creator, index, chain_rank, *, n, r
+    rounds, wt, famous, la, fd, creator, index, chain_rank, valid_mask=None, *, n, r
 ):
     """Round-received + median consensus timestamps — reference
     DecideRoundReceived / MedianTimestamp / OldestSelfAncestorToSee
@@ -262,6 +284,10 @@ def decide_round_received(
     """
     e = rounds.shape[0]
     k = chain_rank.shape[1]
+    if valid_mask is None:
+        in_view = jnp.ones((e,), dtype=jnp.bool_)
+    else:
+        in_view = valid_mask[:e]
     wt_valid = wt >= 0
     wt_safe = jnp.where(wt_valid, wt, 0)
     has_undec = ((famous == FAME_UNDEFINED) & wt_valid).any(1)  # [r]
@@ -280,7 +306,7 @@ def decide_round_received(
         la_w = la[wt_safe[i]]  # [n(w), n]
         see_wx = la_w[:, creator_e] >= index_e[None, :]  # [n(w), E]
         s_cnt = (see_wx & fmask[i][:, None]).sum(0)
-        ok = eligible & (s_cnt > fcnt[i] // 2) & (i > rounds) & (rr < 0)
+        ok = eligible & (s_cnt > fcnt[i] // 2) & (i > rounds) & (rr < 0) & in_view
         return jnp.where(ok, i, rr)
 
     rr = lax.fori_loop(0, r, step, rr0)

@@ -15,6 +15,33 @@ from . import kernels
 
 
 @functools.partial(jax.jit, static_argnames=("n", "sm", "r"))
+def _coordinates_and_rounds(
+    self_parent, other_parent, creator, index, levels, chain, chain_len,
+    root_round, valid_mask=None, *, n, sm, r,
+):
+    la = kernels.compute_last_ancestors(
+        self_parent, other_parent, creator, index, levels, n=n
+    )
+    fd = kernels.compute_first_descendants(la, creator, index, chain, chain_len, n=n)
+    rounds, wit, wt = kernels.compute_rounds(
+        self_parent, other_parent, creator, index, la, fd, levels, root_round,
+        valid_mask, n=n, sm=sm, r=r,
+    )
+    return la, fd, rounds, wit, wt
+
+
+@functools.partial(jax.jit, static_argnames=("n", "sm", "r"))
+def _fame_and_order(wt, la, fd, rounds, creator, index, coin, chain_rank,
+                    valid_mask=None, *, n, sm, r):
+    famous = kernels.decide_fame(wt, la, fd, index, coin, n=n, sm=sm, r=r)
+    rr, cts = kernels.decide_round_received(
+        rounds, wt, famous, la, fd, creator, index, chain_rank, valid_mask,
+        n=n, r=r,
+    )
+    return famous, rr, cts
+
+
+@functools.partial(jax.jit, static_argnames=("n", "sm", "r"))
 def consensus_pipeline(
     self_parent,
     other_parent,
@@ -26,40 +53,56 @@ def consensus_pipeline(
     chain,
     chain_len,
     chain_rank,
+    valid_mask=None,
     *,
     n: int,
     sm: int,
     r: int,
 ):
-    la = kernels.compute_last_ancestors(
-        self_parent, other_parent, creator, index, levels, n=n
+    la, fd, rounds, wit, wt = _coordinates_and_rounds(
+        self_parent, other_parent, creator, index, levels, chain, chain_len,
+        root_round, valid_mask, n=n, sm=sm, r=r,
     )
-    fd = kernels.compute_first_descendants(la, creator, index, chain, chain_len, n=n)
-    rounds, wit, wt = kernels.compute_rounds(
-        self_parent, other_parent, creator, index, la, fd, levels, root_round,
+    famous, rr, cts = _fame_and_order(
+        wt, la, fd, rounds, creator, index, coin, chain_rank, valid_mask,
         n=n, sm=sm, r=r,
-    )
-    famous = kernels.decide_fame(wt, la, fd, index, coin, n=n, sm=sm, r=r)
-    rr, cts = kernels.decide_round_received(
-        rounds, wt, famous, la, fd, creator, index, chain_rank, n=n, r=r
     )
     return rounds, wit, wt, famous, rr, cts
 
 
+def _round_bucket(max_round: int, bound: int) -> int:
+    """Static round capacity for stage 2: next power of two above the
+    observed max round (+2 headroom), bucketed to bound recompiles."""
+    need = max_round + 3
+    r = 8
+    while r < need:
+        r *= 2
+    return min(r, bound)
+
+
 def run_pipeline(dag):
-    """Convenience wrapper over a DagTensors."""
-    return consensus_pipeline(
-        dag.self_parent,
-        dag.other_parent,
-        dag.creator,
-        dag.index,
-        dag.coin,
-        dag.levels,
-        dag.root_round,
-        dag.chain,
-        dag.chain_len,
-        dag.chain_rank,
-        n=dag.n,
-        sm=dag.super_majority,
-        r=dag.max_rounds,
+    """Two-stage driver over a DagTensors.
+
+    The static round bound derived from DAG depth is loose (depth
+    levels can yield only a handful of rounds), and the fame / round-
+    received sweeps cost O(R). Stage 1 computes coordinates + rounds
+    under the loose bound; one scalar host read of the actual max round
+    then sizes stage 2 tightly."""
+    import numpy as np
+
+    n, sm, r_bound = dag.n, dag.super_majority, dag.max_rounds
+    la, fd, rounds, wit, wt = _coordinates_and_rounds(
+        dag.self_parent, dag.other_parent, dag.creator, dag.index, dag.levels,
+        dag.chain, dag.chain_len, dag.root_round, n=n, sm=sm, r=r_bound,
     )
+    max_round = int(np.asarray(rounds).max()) if dag.e else 0
+    r_small = _round_bucket(max_round, r_bound)
+    famous_small, rr, cts = _fame_and_order(
+        wt[:r_small], la, fd, rounds, dag.creator, dag.index, dag.coin,
+        dag.chain_rank, n=n, sm=sm, r=r_small,
+    )
+    # Restore the [max_rounds, n] shape contract: rounds beyond r_small
+    # have no witnesses (wt rows are -1) and stay UNDEFINED.
+    famous = np.zeros((r_bound, n), dtype=np.int32)
+    famous[:r_small] = np.asarray(famous_small)
+    return rounds, wit, wt, famous, rr, cts

@@ -1,0 +1,152 @@
+"""Batched gossip simulation: per-peer views as one vmap.
+
+The reference runs N OS processes exchanging syncs (reference
+node/node.go:315-487); the batched simulator replays that protocol as
+tensors: a peer-selection schedule generates the DAG, knowledge masks
+track which events each peer has seen (gossip transfers the full
+ancestry closure, so every view is ancestry-closed), and consensus for
+ALL views is one `vmap` of the masked pipeline over the mask axis —
+the checkGossip oracle (node/node_test.go:548-599) computed on device.
+
+Ancestry-closure is what makes this sound: coordinates (last_anc /
+first_desc) computed once on the full DAG are exact for every closed
+subgraph (see kernels.compute_rounds), so views differ only in their
+witness tables.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..gojson import Timestamp
+from .. import crypto
+from ..hashgraph.event import Event
+from .dag import DagTensors, build_dag
+from .pipeline import consensus_pipeline
+
+
+class GossipSim:
+    """Host-side gossip simulator over real signed events, tracking
+    per-peer knowledge (used for view-parity tests; the all-array
+    `synthetic_dag` is the benchmark path)."""
+
+    def __init__(self, n: int, seed: int = 0, seed_base: int = 9000):
+        self.n = n
+        self.rng = random.Random(seed)
+        self.keys = [crypto.key_from_seed(seed_base + i) for i in range(n)]
+        self.pubs = [crypto.pub_key_bytes(k) for k in self.keys]
+        order = sorted(range(n), key=lambda i: self.pubs[i].hex())
+        self.ids = {orig: rank for rank, orig in enumerate(order)}
+        self.participants = {
+            "0x" + self.pubs[i].hex().upper(): self.ids[i] for i in range(n)
+        }
+        self.events: List[Event] = []
+        self.heads: List[str] = [""] * n
+        self.seqs: List[int] = [-1] * n
+        self.knows: List[set] = [set() for _ in range(n)]
+        self._clock = 1_800_000_000_000_000_000
+
+    def _make_event(self, i: int, other_parent: str, payload) -> Event:
+        self._clock += 1_000_000
+        self.seqs[i] += 1
+        ev = Event.new(
+            payload, [self.heads[i], other_parent], self.pubs[i], self.seqs[i],
+            timestamp=Timestamp(self._clock),
+        )
+        ev.sign(self.keys[i])
+        eid = len(self.events)
+        self.events.append(ev)
+        self.heads[i] = ev.hex()
+        self.knows[i].add(eid)
+        return ev
+
+    def run(self, steps: int, tx_rate: float = 0.3) -> None:
+        if not self.events:
+            for i in range(self.n):
+                self._make_event(i, "", [f"init{i}".encode()])
+        for t in range(steps):
+            i = self.rng.randrange(self.n)
+            j = self.rng.choice([x for x in range(self.n) if x != i])
+            # pull: i learns everything j knows, then records the sync
+            self.knows[i] |= self.knows[j]
+            payload = [f"tx{t}".encode()] if self.rng.random() < tx_rate else []
+            self._make_event(i, self.heads[j], payload)
+
+    def view_masks(self) -> np.ndarray:
+        """[n, E] bool: which events each peer's view contains."""
+        e = len(self.events)
+        masks = np.zeros((self.n, e), dtype=bool)
+        for i in range(self.n):
+            masks[self.ids[i], list(self.knows[i])] = True
+        return masks
+
+    def dag(self) -> DagTensors:
+        return build_dag(self.events, self.participants)
+
+
+def consensus_views(dag: DagTensors, masks: np.ndarray):
+    """Run the masked consensus pipeline for V views in one vmap.
+
+    masks: [V, E] bool. Returns per-view (rounds, witness, wt, famous,
+    rr, cts) with a leading V axis.
+    """
+    v, e = masks.shape
+    assert e == dag.e
+    padded = np.zeros((v, e + 1), dtype=bool)
+    padded[:, :e] = masks
+
+    def run_one(mask):
+        return consensus_pipeline(
+            dag.self_parent,
+            dag.other_parent,
+            dag.creator,
+            dag.index,
+            dag.coin,
+            dag.levels,
+            dag.root_round,
+            dag.chain,
+            dag.chain_len,
+            dag.chain_rank,
+            mask,
+            n=dag.n,
+            sm=dag.super_majority,
+            r=dag.max_rounds,
+        )
+
+    return jax.vmap(run_one)(padded)
+
+
+def view_order(dag: DagTensors, rr: np.ndarray, cts: np.ndarray,
+               s_ints: Optional[List[int]] = None) -> List[int]:
+    """Consensus total order of one view as event ids: (roundReceived,
+    consensusTimestamp, raw S) — the ConsensusSorter (reference
+    consensus_sorter.go:21-52)."""
+    if s_ints is None:
+        s_ints = [int(ev.s) for ev in dag.events]
+    ids = [i for i in range(dag.e) if rr[i] >= 0]
+    ids.sort(key=lambda i: (int(rr[i]), int(cts[i]), s_ints[i]))
+    return ids
+
+
+def check_view_consistency(dag: DagTensors, rr_v: np.ndarray,
+                           cts_v: np.ndarray) -> List[List[int]]:
+    """The checkGossip oracle over all views: every pair of views'
+    consensus orders must be prefix-compatible. Prefix-compatibility
+    with the longest order implies it pairwise, so each view is checked
+    against the longest only. Returns the per-view orders; raises
+    AssertionError on divergence."""
+    s_ints = [int(ev.s) for ev in dag.events] if dag.events else None
+    orders = [
+        view_order(dag, rr_v[v], cts_v[v], s_ints) for v in range(rr_v.shape[0])
+    ]
+    longest = max(orders, key=len)
+    for v, order in enumerate(orders):
+        if order != longest[: len(order)]:
+            raise AssertionError(
+                f"view {v} diverges from the longest view within its prefix"
+            )
+    return orders
