@@ -80,14 +80,9 @@ def _round_bucket(max_round: int, bound: int) -> int:
     return min(r, bound)
 
 
-def run_pipeline(dag):
-    """Two-stage driver over a DagTensors.
-
-    The static round bound derived from DAG depth is loose (depth
-    levels can yield only a handful of rounds), and the fame / round-
-    received sweeps cost O(R). Stage 1 computes coordinates + rounds
-    under the loose bound; one scalar host read of the actual max round
-    then sizes stage 2 tightly."""
+def run_pipeline_wavefront(dag):
+    """The original depth-sequential driver (one dispatch step per DAG
+    level) — kept as a second oracle for kernel cross-validation."""
     import numpy as np
 
     n, sm, r_bound = dag.n, dag.super_majority, dag.max_rounds
@@ -103,6 +98,63 @@ def run_pipeline(dag):
     )
     # Restore the [max_rounds, n] shape contract: rounds beyond r_small
     # have no witnesses (wt rows are -1) and stay UNDEFINED.
+    famous = np.zeros((r_bound, n), dtype=np.int32)
+    famous[:r_small] = np.asarray(famous_small)
+    return rounds, wit, wt, famous, rr, cts
+
+
+def _default_engine() -> str:
+    """Hardware-adaptive default: the block-closure/round-frontier path
+    trades FLOPs (dense boolean matmuls) for sequential trip count —
+    the right trade on a TPU MXU, the wrong one on a host CPU where
+    dispatch is cheap and FLOPs are scarce. Tests and the CPU bench
+    fallback therefore keep the wavefront."""
+    import jax
+
+    return "closure" if jax.default_backend() not in ("cpu",) else "wavefront"
+
+
+def run_pipeline(dag, block: int = 512, engine: str = "auto"):
+    """Consensus pipeline driver over a DagTensors.
+
+    engine="closure": trip counts scale with E/block + number-of-rounds,
+    not DAG depth — coordinates from the block-closure kernel
+    (ops/closure.py), rounds from the witness-frontier sweep
+    (ops/frontier.py, one step per round), then fame / round-received at
+    a tight round bound read from the frontier. engine="wavefront": the
+    depth-sequential drivers. engine="auto" picks by backend
+    (_default_engine). Output contracts are identical."""
+    import numpy as np
+
+    from . import closure, frontier
+
+    if engine == "auto":
+        engine = _default_engine()
+    if engine == "wavefront":
+        return run_pipeline_wavefront(dag)
+
+    n, sm = dag.n, dag.super_majority
+    block = min(block, max(64, 1 << (dag.e - 1).bit_length())) if dag.e else 64
+    la, rbase = closure.coordinates(dag, block=block)
+    fd = kernels.compute_first_descendants(
+        la, dag.creator, dag.index, dag.chain, dag.chain_len, n=n)
+    wt_np, fr_rel, rho_min = frontier.compute_frontier(
+        la, rbase, fd, dag.chain, dag.chain_len, dag.root_round, n=n, sm=sm)
+    e = dag.e
+    rounds, wit = frontier.rounds_from_frontier(
+        fr_rel, dag.creator[:e], dag.index[:e], dag.self_parent[:e],
+        rho_min, n=n)
+    max_round = wt_np.shape[0] - 1
+    r_bound = max(dag.max_rounds, max_round + 1)
+    r_small = _round_bucket(max_round, r_bound)
+    wt_small = np.full((r_small, n), -1, dtype=np.int32)
+    wt_small[: min(r_small, wt_np.shape[0])] = wt_np[:r_small]
+    famous_small, rr, cts = _fame_and_order(
+        wt_small, la, fd, rounds, dag.creator, dag.index, dag.coin,
+        dag.chain_rank, n=n, sm=sm, r=r_small,
+    )
+    wt = np.full((r_bound, n), -1, dtype=np.int32)
+    wt[: wt_np.shape[0]] = wt_np
     famous = np.zeros((r_bound, n), dtype=np.int32)
     famous[:r_small] = np.asarray(famous_small)
     return rounds, wit, wt, famous, rr, cts

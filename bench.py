@@ -1,88 +1,297 @@
 #!/usr/bin/env python
 """Benchmark: consensus throughput of the batched TPU engine.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "events/s", "vs_baseline": N}
+Prints ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": "events/s", "vs_baseline": N, ...}
 
-Baseline: the reference Go implementation's published steady-state
-gossip throughput — 265.53-268.27 events/s to consensus on a 4-node
-docker testnet (reference docs/usage.rst:31-34); we compare against the
-midpoint 266.9. The benchmark drives the flagship jitted pipeline
-(divide rounds -> decide fame -> find order, babble_tpu/ops) over a
-synthetic random-gossip DAG at N=64 peers — 16x the reference's peer
-count — and reports events/sec to full consensus order, including the
-host-side final sort.
+Robustness contract (the round-2 bench died to a transiently-Unavailable
+TPU backend and an unbounded run): the parent process never imports JAX.
+It probes the backend in a subprocess with a hard timeout and bounded
+retries, runs the measurement in a budgeted subprocess, keeps the last
+partial result the child reported, and ALWAYS emits the stdout JSON
+line — with an "error" field when something failed and a CPU fallback
+when the TPU never comes up.
 
-Extra context (host-engine comparison, other sizes) goes to stderr;
-the driver consumes only the stdout JSON line.
+Metric: events/sec to full consensus order (device pipeline + host
+final sort) at N=64 peers over a 50k-event synthetic random-gossip DAG
+— the event pattern the gossip runtime produces (reference
+node/node.go:315-487). `vs_baseline` is the honest like-for-like
+multiple: this repo's own reference-semantics host engine on the same
+topology (real signed events, ECDSA verify on insert, same gossip
+pattern). The reference's published 4-node docker steady state
+(265.53-268.27 ev/s, reference docs/usage.rst:31-34) is reported
+separately as `ref_docker_events_per_s` — an indicative, not
+like-for-like, anchor.
+
+Stages (each emits a partial JSON line; later stages refine):
+  smoke     n=8    e=256     proves the pipeline end-to-end
+  headline  n=64   e=50_000  the reported metric
+  northstar n=1024 e=100_000 BASELINE.md driver target size
+  host      n=64   same topology subset -> vs_baseline denominator
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "150"))
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+CACHE_DIR = os.environ.get(
+    "JAX_COMPILATION_CACHE_DIR", "/tmp/babble_tpu_jax_cache"
+)
+
+_T0 = time.monotonic()
 
 
 def log(msg):
-    print(msg, file=sys.stderr, flush=True)
+    print(f"[bench +{time.monotonic() - _T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
 
 
-def time_pipeline(dag, s_rank, warm=1, reps=3):
+# --------------------------------------------------------------------------
+# Parent: probe + budgeted child + guaranteed JSON emission.
+# --------------------------------------------------------------------------
+
+_PROBE_SRC = (
+    "import jax, json;"
+    "d = jax.devices();"
+    "print(json.dumps({'backend': jax.default_backend(), 'n': len(d),"
+    " 'kind': d[0].device_kind}))"
+)
+
+
+def probe_backend():
+    """Can a fresh process initialize the configured JAX backend? The
+    axon TPU tunnel is transiently Unavailable and sometimes hangs in
+    init (observed >8 min), so each attempt is a subprocess with a hard
+    timeout."""
+    for attempt in range(1, PROBE_ATTEMPTS + 1):
+        t0 = time.monotonic()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                info = json.loads(out.stdout.strip().splitlines()[-1])
+                log(f"backend probe ok in {time.monotonic() - t0:.1f}s: {info}")
+                return info
+            log(f"probe attempt {attempt}/{PROBE_ATTEMPTS} rc={out.returncode}"
+                f" stderr: ...{out.stderr.strip()[-300:]}")
+        except subprocess.TimeoutExpired:
+            log(f"probe attempt {attempt}/{PROBE_ATTEMPTS} timed out"
+                f" after {PROBE_TIMEOUT_S:.0f}s")
+        except Exception as exc:  # noqa: BLE001
+            log(f"probe attempt {attempt}/{PROBE_ATTEMPTS} failed: {exc}")
+        time.sleep(min(5.0 * attempt, 20.0))
+    return None
+
+
+def run_child(env, timeout):
+    """Run the measurement child; return (last partial payload, error)."""
+    env = dict(env)
+    # Let the child's budget clock account for parent time already spent.
+    env["BENCH_T0_OFFSET"] = str(time.monotonic() - _T0)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        stdout=subprocess.PIPE, stderr=sys.stderr, text=True, env=env,
+    )
+    # A hanging child produces no stdout, and readline() would block
+    # past any deadline — so a reader thread drains stdout while the
+    # parent enforces the budget on proc.wait().
+    import threading
+
+    results = []
+
+    def drain():
+        for line in proc.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                results.append(json.loads(line))
+            except json.JSONDecodeError:
+                log(f"child emitted non-JSON stdout: {line[:200]}")
+
+    reader = threading.Thread(target=drain, daemon=True)
+    reader.start()
+    err = None
+    try:
+        rc = proc.wait(timeout=timeout)
+        if rc != 0:
+            err = f"child exited rc={rc}"
+    except subprocess.TimeoutExpired:
+        err = f"child exceeded budget ({timeout:.0f}s), killed"
+        proc.kill()
+        proc.wait()
+    except Exception as exc:  # noqa: BLE001
+        err = f"child failed: {exc}"
+        proc.kill()
+        proc.wait()
+    reader.join(timeout=5.0)
+    return (results[-1] if results else None), err
+
+
+def _cpu_env(env):
+    """CPU-only child env. JAX_PLATFORMS=cpu alone is not enough: the
+    environment's sitecustomize registers (and dials) the axon PJRT
+    plugin whenever PALLAS_AXON_POOL_IPS is set, and that dial is what
+    hangs when the tunnel is down — so the trigger var must go too."""
+    env = dict(env)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def main():
+    env = os.environ.copy()
+    env["JAX_COMPILATION_CACHE_DIR"] = CACHE_DIR
+    os.makedirs(CACHE_DIR, exist_ok=True)
+
+    info = probe_backend()
+    fallback = None
+    if info is None:
+        fallback = "configured backend unreachable; fell back to CPU"
+        log(fallback)
+        env = _cpu_env(env)
+        info = {"backend": "cpu", "n": 1, "kind": "fallback-cpu"}
+
+    child_budget = BUDGET_S - (time.monotonic() - _T0) - 10.0
+    payload, err = run_child(env, max(child_budget, 60.0))
+
+    if (payload is None or not payload.get("value")) and fallback is None:
+        # TPU probe passed but the run died/hung before producing a
+        # headline number: one CPU retry with whatever budget remains,
+        # so the round still gets a number. Backend labels are only
+        # switched if the retry's payload is actually the one kept.
+        log(f"no headline result from backend run ({err}); retrying on CPU")
+        retry_budget = BUDGET_S - (time.monotonic() - _T0) - 5.0
+        if retry_budget > 60.0:
+            retry_payload, retry_err = run_child(_cpu_env(env), retry_budget)
+            if retry_payload is not None and (
+                payload is None or retry_payload.get("value")
+            ):
+                payload = retry_payload
+                info = {"backend": "cpu", "n": 1, "kind": "fallback-cpu"}
+                fallback = (f"tpu run produced no headline number ({err}); "
+                            "CPU fallback")
+                err = retry_err
+
+    if payload is None:
+        payload = {
+            "metric": "consensus_events_per_s_n64",
+            "value": 0.0,
+            "unit": "events/s",
+            "vs_baseline": 0.0,
+        }
+    payload.setdefault("backend", info.get("backend"))
+    payload["device_kind"] = info.get("kind")
+    notes = [x for x in (fallback, err) if x]
+    if notes:
+        payload["error"] = "; ".join(dict.fromkeys(notes))
+    payload["wall_s"] = round(time.monotonic() - _T0, 1)
+    print(json.dumps(payload), flush=True)
+
+
+# --------------------------------------------------------------------------
+# Child: the actual measurement. Emits a (partial) JSON line after every
+# completed stage so a mid-run kill still leaves the best result so far.
+# --------------------------------------------------------------------------
+
+
+def _emit(payload):
+    print(json.dumps(payload), flush=True)
+
+
+def _budget_left():
+    offset = float(os.environ.get("BENCH_T0_OFFSET", "0"))
+    return BUDGET_S - offset - (time.monotonic() - _T0) - 30.0
+
+
+def time_pipeline(dag, s_rank, warm=1, reps=3, engine="auto"):
+    import numpy as np
+
     from babble_tpu.ops.pipeline import run_pipeline
 
+    t0 = time.monotonic()
     for _ in range(warm):
-        out = run_pipeline(dag)
-        out[0].block_until_ready()
+        out = run_pipeline(dag, engine=engine)
+        np.asarray(out[0])
+    log(f"  [{engine}] compile+warmup {time.monotonic() - t0:.1f}s")
     best = float("inf")
-    result = None
+    n_consensus = 0
+    max_round = 0
     for _ in range(reps):
         t0 = time.perf_counter()
-        out = run_pipeline(dag)
+        out = run_pipeline(dag, engine=engine)
         rounds, wit, wt, famous, rr, cts = [np.asarray(x) for x in out]
         # host finish: the consensus total order (rr, ts, S-tiebreak)
         mask = rr >= 0
-        order = np.lexsort((s_rank[mask], cts[mask], rr[mask]))
+        np.lexsort((s_rank[mask], cts[mask], rr[mask]))
         dt = time.perf_counter() - t0
         if dt < best:
             best = dt
-            result = (rounds, rr, mask, order)
-    return best, result
+            n_consensus = int(mask.sum())
+            max_round = int(rounds.max())
+    return best, n_consensus, max_round
 
 
-def host_engine_events_per_sec(n_peers=4, n_events=600, seed=7):
-    """Reference-semantics host engine on real signed events, for the
-    stderr comparison line."""
-    import random
+def tune_engine(dag, s_rank):
+    """Time both pipeline engines once and return the faster — the
+    closure/frontier path is built for the MXU, the wavefront for
+    dispatch-cheap backends; measuring beats guessing on an unknown
+    chip."""
+    results = {}
+    for engine in ("closure", "wavefront"):
+        if _budget_left() < 60:
+            break
+        try:
+            best, _, _ = time_pipeline(dag, s_rank, warm=1, reps=1,
+                                       engine=engine)
+            results[engine] = best
+            log(f"  tune: {engine} {best * 1e3:.1f} ms")
+        except Exception as exc:  # noqa: BLE001
+            log(f"  tune: {engine} failed: {exc}")
+    if not results:
+        return "auto"
+    return min(results, key=results.get)
 
+
+def host_engine_events_per_sec(n_peers, n_events, seed=7):
+    """This repo's reference-semantics host engine on real signed
+    events with the same gossip topology — the honest like-for-like
+    baseline."""
     from babble_tpu import crypto
     from babble_tpu.gojson import Timestamp
     from babble_tpu.hashgraph import Event, Hashgraph, InmemStore
+    import numpy as np
 
-    rng = random.Random(seed)
+    rng = np.random.default_rng(seed)
     keys = [crypto.key_from_seed(3000 + i) for i in range(n_peers)]
     pubs = [crypto.pub_key_bytes(k) for k in keys]
     participants = {"0x" + p.hex().upper(): i for i, p in enumerate(pubs)}
-    clock = [1_700_000_000_000_000_000]
+    clock = 1_700_000_000_000_000_000
     heads = [""] * n_peers
     seqs = [-1] * n_peers
     events = []
 
-    def make(i, op):
-        clock[0] += 1_000_000
-        seqs[i] += 1
-        ev = Event.new([b"tx"], [heads[i], op], pubs[i], seqs[i],
-                       timestamp=Timestamp(clock[0]))
-        ev.sign(keys[i])
-        heads[i] = ev.hex()
+    creators = np.concatenate(
+        [np.arange(n_peers), rng.integers(0, n_peers, size=n_events - n_peers)]
+    )
+    others = rng.integers(1, n_peers, size=n_events)
+    for i in range(n_events):
+        c = int(creators[i])
+        op = heads[(c + int(others[i])) % n_peers] if i >= n_peers else ""
+        clock += 1_000_000
+        seqs[c] += 1
+        ev = Event.new([b"tx"], [heads[c], op], pubs[c], seqs[c],
+                       timestamp=Timestamp(clock))
+        ev.sign(keys[c])
+        heads[c] = ev.hex()
         events.append(ev)
-
-    for i in range(n_peers):
-        make(i, "")
-    for _ in range(n_events - n_peers):
-        i = rng.randrange(n_peers)
-        j = rng.choice([x for x in range(n_peers) if x != i])
-        make(i, heads[j])
 
     h = Hashgraph(participants, InmemStore(participants, 2 * n_events))
     t0 = time.perf_counter()
@@ -90,40 +299,110 @@ def host_engine_events_per_sec(n_peers=4, n_events=600, seed=7):
         h.insert_event(ev, True)
     h.run_consensus()
     dt = time.perf_counter() - t0
-    done = len(h.consensus_events())
-    return done / dt, done
+    return len(h.consensus_events()) / dt, len(h.consensus_events())
 
 
-def main():
+def child():
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    log(f"child up: backend={jax.default_backend()} "
+        f"devices={[d.device_kind for d in jax.devices()]}")
+
     from babble_tpu.ops.dag import synthetic_dag
 
-    n, e = 64, 50_000
-    t_gen = time.perf_counter()
-    dag, s_rank = synthetic_dag(n, e, seed=1, max_level_width=512)
-    log(f"synthetic DAG: n={n} e={e} levels={dag.levels.shape} "
-        f"gen={time.perf_counter()-t_gen:.2f}s")
-
-    best, (rounds, rr, mask, order) = time_pipeline(dag, s_rank)
-    n_consensus = int(mask.sum())
-    ev_per_s = n_consensus / best
-    log(f"batched engine: {best*1e3:.1f} ms -> {n_consensus} consensus events "
-        f"({ev_per_s:,.0f} events/s), last round {int(rounds.max())}")
-
-    try:
-        host_eps, host_done = host_engine_events_per_sec()
-        log(f"host engine (4 peers, real events): {host_eps:,.0f} events/s "
-            f"({host_done} consensus events)")
-    except Exception as exc:  # noqa: BLE001 - bench context only
-        log(f"host engine comparison skipped: {exc}")
-
-    baseline = 266.9
-    print(json.dumps({
+    ref_docker = 266.9  # reference docs/usage.rst:31-34 midpoint
+    payload = {
         "metric": "consensus_events_per_s_n64",
-        "value": round(ev_per_s, 1),
+        "value": 0.0,
         "unit": "events/s",
-        "vs_baseline": round(ev_per_s / baseline, 1),
-    }))
+        "vs_baseline": 0.0,
+        "baseline": "repo host engine, same topology (see host_* fields)",
+        "ref_docker_events_per_s": ref_docker,
+    }
+
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR")
+
+    # -- stage 0: smoke ----------------------------------------------------
+    log("stage smoke: n=8 e=256")
+    dag, s_rank = synthetic_dag(8, 256, seed=0)
+    best, n_cons, _ = time_pipeline(dag, s_rank, warm=1, reps=2)
+    log(f"  smoke ok: {best * 1e3:.1f} ms, {n_cons} consensus events")
+    payload["smoke_events_per_s"] = round(n_cons / best, 1)
+    _emit(payload)
+
+    # -- stage 1: headline n=64 e=50k -------------------------------------
+    engine = "auto"
+    if _budget_left() > 60:
+        n, e = 64, 50_000
+        log(f"stage headline: n={n} e={e}")
+        t0 = time.monotonic()
+        dag, s_rank = synthetic_dag(n, e, seed=1)
+        log(f"  DAG gen {time.monotonic() - t0:.1f}s, "
+            f"levels={dag.levels.shape}")
+        engine = tune_engine(dag, s_rank)
+        log(f"  tuned engine: {engine}")
+        if profile_dir:
+            jax.profiler.start_trace(profile_dir)
+        best, n_cons, max_round = time_pipeline(dag, s_rank, engine=engine)
+        if profile_dir:
+            jax.profiler.stop_trace()
+        eps = n_cons / best
+        log(f"  headline: {best * 1e3:.1f} ms -> {n_cons} consensus events "
+            f"({eps:,.0f} ev/s), last round {max_round}")
+        payload["value"] = round(eps, 1)
+        payload["engine"] = engine
+        payload["headline_ms"] = round(best * 1e3, 2)
+        payload["headline_consensus_events"] = n_cons
+        _emit(payload)
+
+    # -- stage 2: host-engine baseline, same topology ---------------------
+    if _budget_left() > 60:
+        # e must be large enough that fame decides and events reach
+        # consensus at n=64 (a round is ~700 events at this fan-out).
+        host_n_events = 5000
+        log(f"stage host baseline: n=64 e={host_n_events} "
+            "(same topology family)")
+        host_eps, host_done = host_engine_events_per_sec(64, host_n_events)
+        log(f"  host engine: {host_eps:,.0f} ev/s ({host_done} consensus)")
+        payload["host_events_per_s"] = round(host_eps, 1)
+        payload["host_events"] = host_n_events
+        if payload["value"] and host_eps > 0:
+            payload["vs_baseline"] = round(payload["value"] / host_eps, 1)
+        _emit(payload)
+
+    # -- stage 3: north star n=1024 e=100k --------------------------------
+    # Skipped on the CPU fallback: at this size a host CPU cannot finish
+    # inside any reasonable budget, and the number is only meaningful on
+    # the chip (BASELINE.md north-star target).
+    on_cpu = jax.default_backend() == "cpu"
+    force_ns = os.environ.get("BENCH_FORCE_NORTHSTAR") == "1"
+    if _budget_left() > 300 and (not on_cpu or force_ns):
+        n, e = 1024, 100_000
+        log(f"stage northstar: n={n} e={e}")
+        t0 = time.monotonic()
+        dag, s_rank = synthetic_dag(n, e, seed=2)
+        log(f"  DAG gen {time.monotonic() - t0:.1f}s, "
+            f"levels={dag.levels.shape}")
+        try:
+            best, n_cons, max_round = time_pipeline(dag, s_rank, warm=1,
+                                                    reps=2, engine=engine)
+            eps = n_cons / best
+            log(f"  northstar: {best * 1e3:.1f} ms -> {n_cons} consensus "
+                f"({eps:,.0f} ev/s), last round {max_round}")
+            payload["northstar_events_per_s"] = round(eps, 1)
+            payload["northstar_n"] = n
+            payload["northstar_events"] = e
+            _emit(payload)
+        except Exception as exc:  # noqa: BLE001
+            log(f"  northstar failed: {exc}")
+
+    _emit(payload)
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        child()
+    else:
+        main()
