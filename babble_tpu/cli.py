@@ -79,6 +79,7 @@ def cmd_run(args) -> int:
         sync_limit=args.sync_limit,
         store_type=args.store,
         store_path=args.store_path or os.path.join(datadir, "store.db"),
+        engine=args.engine,
         logger=logger,
     )
 
@@ -160,6 +161,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="store backend")
     rn.add_argument("--store_path", default="",
                     help="path of the file store database")
+    rn.add_argument("--engine", default="host", choices=["host", "tpu"],
+                    help="consensus engine: reference-semantics host "
+                         "driver or the batched device pipeline")
     rn.set_defaults(fn=cmd_run)
 
     vs = sub.add_parser("version", help="print version")

@@ -1,0 +1,219 @@
+"""Device-backed hashgraph engine behind the same Store/engine seam.
+
+TpuHashgraph presents the exact Hashgraph surface Core drives
+(insert_event / run_consensus / known / read_wire_info / get_frame,
+reference node/core.go:277-296) but delegates the whole consensus
+pipeline — DivideRounds, DecideFame, FindOrder (reference
+hashgraph.go:616-858) — to the batched incremental device engine
+(ops/incremental.py). The host keeps what a host should: crypto
+verification, wire-format resolution, the Store mirror for sync diffs,
+and block assembly; per-participant ancestry coordinates and virtual
+voting live in HBM.
+
+Inserts are O(1) host work (the reference's per-insert O(n) coordinate
+vectors and first-descendant chain walk, hashgraph.go:448-530, move to
+the device pipeline), so insert cost is dominated by the ECDSA verify —
+and run_consensus cost is amortized over the undecided tip instead of
+the whole DAG.
+
+Bookkeeping side effects (RoundInfo rows, consensus list, blocks,
+counters) are mirrored into the Store from the engine's RunDelta so
+/Stats, frames, and persistence behave identically to the host engine.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..gojson import Timestamp, ZERO_TIME
+from ..ops.incremental import IncrementalEngine, RunDelta, ZERO_TIME_NS
+from .block import Block
+from .event import Event
+from .graph import Hashgraph, InsertError, middle_bit
+from .root import Root
+from .round_info import RoundInfo
+from .store import Store
+from ..common import StoreError, StoreErrType, is_store_err
+
+
+class TpuHashgraph(Hashgraph):
+    def __init__(
+        self,
+        participants: Dict[str, int],
+        store: Store,
+        commit_callback: Optional[Callable[[Block], None]] = None,
+        *,
+        capacity: int = 256,
+        block: int = 256,
+    ):
+        super().__init__(participants, store, commit_callback)
+        self.engine = IncrementalEngine(
+            len(participants), capacity=capacity, block=block)
+        self._eid_of: Dict[str, int] = {}
+        # eid -> hex only; Event objects stay in the Store so its cache
+        # bound (not this map) governs host memory.
+        self._hex_by_id: List[str] = []
+        # Mirror the host engine's initial queue (graph.py / reference
+        # hashgraph.go: UndecidedRounds starts [0]).
+        self.undecided_rounds = list(self.engine.undecided_rounds)
+
+    # -- insertion: host checks + device append -----------------------------
+
+    def insert_event(self, event: Event, set_wire_info: bool) -> None:
+        if not event.verify():
+            raise InsertError("Invalid signature")
+        try:
+            self._check_self_parent(event)
+        except Exception as e:
+            raise InsertError(f"CheckSelfParent: {e}") from e
+        try:
+            self._check_other_parent(event)
+        except Exception as e:
+            raise InsertError(f"CheckOtherParent: {e}") from e
+
+        event.topological_index = self.topological_index
+        self.topological_index += 1
+        if set_wire_info:
+            self._set_wire_info(event)
+
+        sp = self._eid_of.get(event.self_parent(), -1)
+        op = self._eid_of.get(event.other_parent(), -1)
+        pid = self.participants[event.creator()]
+        eid = self.engine.append(
+            sp, op, pid, event.index(),
+            middle_bit(event.hex()), event.body.timestamp.ns,
+        )
+        self._eid_of[event.hex()] = eid
+        self._hex_by_id.append(event.hex())
+
+        self.store.set_event(event)
+        self.undetermined_events.append(event.hex())
+        if event.is_loaded():
+            self.pending_loaded_events += 1
+
+    # -- consensus: one device pipeline call + Store mirroring --------------
+
+    def run_consensus(self) -> None:
+        delta = self.engine.run()
+        self._apply_delta(delta)
+
+    def divide_rounds(self) -> None:  # test-surface compatibility
+        self.run_consensus()
+
+    def decide_fame(self) -> None:
+        pass
+
+    def find_order(self) -> None:
+        pass
+
+    def _get_or_new_round(self, r: int) -> RoundInfo:
+        try:
+            return self.store.get_round(r)
+        except StoreError as err:
+            if not is_store_err(err, StoreErrType.KEY_NOT_FOUND):
+                raise
+            return RoundInfo()
+
+    def _apply_delta(self, delta: RunDelta) -> None:
+        # DivideRounds mirror (hashgraph.go:616-646).
+        touched: Dict[int, RoundInfo] = {}
+        for eid, rnd, wit in delta.new_rounds:
+            ri = touched.get(rnd)
+            if ri is None:
+                ri = self._get_or_new_round(rnd)
+                touched[rnd] = ri
+            ri.queued = True
+            ri.add_event(self._hex_by_id[eid], wit)
+        # DecideFame mirror (hashgraph.go:649-730).
+        for rnd, eid, famous in delta.fame_updates:
+            ri = touched.get(rnd)
+            if ri is None:
+                ri = self._get_or_new_round(rnd)
+                touched[rnd] = ri
+            ri.set_fame(self._hex_by_id[eid], famous)
+        for rnd, ri in sorted(touched.items()):
+            self.store.set_round(rnd, ri)
+        self.undecided_rounds = list(self.engine.undecided_rounds)
+        if delta.last_consensus_round is not None and (
+            self.last_consensus_round is None
+            or delta.last_consensus_round > self.last_consensus_round
+        ):
+            self.last_consensus_round = delta.last_consensus_round
+            self.last_commited_round_events = delta.last_commited_round_events
+
+        # FindOrder mirror (hashgraph.go:801-858): sort this call's batch
+        # by (roundReceived, consensusTimestamp, raw big-int S) — the
+        # ConsensusSorter with its never-populated-PRN quirk
+        # (consensus_sorter.go:21-52) — then assemble per-call blocks.
+        if not delta.new_received:
+            return
+        batch = []
+        for eid, rr, cts_ns in delta.new_received:
+            ev = self.store.get_event(self._hex_by_id[eid])
+            ev.set_round_received(rr)
+            ev.consensus_timestamp = (
+                ZERO_TIME if cts_ns == ZERO_TIME_NS else Timestamp(cts_ns))
+            self.store.set_event(ev)
+            batch.append(ev)
+        batch.sort(
+            key=lambda e: (e.round_received, e.consensus_timestamp.ns, int(e.s))
+        )
+        received = {e.hex() for e in batch}
+        self.undetermined_events = [
+            x for x in self.undetermined_events if x not in received
+        ]
+
+        block_map: Dict[int, Block] = {}
+        block_order: List[int] = []
+        for e in batch:
+            self.store.add_consensus_event(e.hex())
+            self.consensus_transactions += len(e.transactions() or [])
+            if e.is_loaded():
+                self.pending_loaded_events -= 1
+            b = block_map.get(e.round_received)
+            etxs = e.transactions()
+            if b is None:
+                b = Block(e.round_received, None if etxs is None else list(etxs))
+                block_order.append(e.round_received)
+                block_map[e.round_received] = b
+            elif etxs:
+                if b.transactions is None:
+                    b.transactions = list(etxs)
+                else:
+                    b.transactions.extend(etxs)
+        for rr in block_order:
+            block = block_map[rr]
+            self.store.set_block(block)
+            if self.commit_callback is not None and block.transactions:
+                self.commit_callback(block)
+
+    # -- queries served from device results ---------------------------------
+
+    def round(self, x: str) -> int:
+        eid = self._eid_of.get(x)
+        if eid is None:
+            return -1
+        return self.engine.round_of(eid)
+
+    def witness(self, x: str) -> bool:
+        eid = self._eid_of.get(x)
+        if eid is None:
+            return False
+        return bool(self.engine.witness[eid])
+
+    def round_received(self, x: str) -> int:
+        eid = self._eid_of.get(x)
+        if eid is None:
+            return -1
+        r = int(self.engine.rr[eid])
+        return r if r >= 0 else -1
+
+    # -- checkpoint / recovery ----------------------------------------------
+
+    def reset(self, roots: Dict[str, Root]) -> None:
+        raise NotImplementedError(
+            "TpuHashgraph does not support frame reset (offset chain "
+            "bases); the reference's fast-sync consumer is a stub "
+            "(node/node.go:432-441) — use the host engine for "
+            "reset-from-frame flows"
+        )

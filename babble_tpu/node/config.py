@@ -20,6 +20,9 @@ class Config:
     sync_limit: int = 100
     store_type: str = "inmem"  # "inmem" | "file"
     store_path: str = ""
+    # Consensus engine: "host" (incremental reference-semantics Python)
+    # or "tpu" (batched device pipeline behind the same seam).
+    engine: str = "host"
     logger: logging.Logger = field(default_factory=_default_logger)
 
 

@@ -23,12 +23,22 @@ class Core:
         participants: Dict[str, int],
         store: Store,
         commit_callback: Optional[Callable[[Block], None]] = None,
+        engine: str = "host",
     ):
         self.id = id
         self.key = key
         self._pub_key: Optional[bytes] = None
         self._hex_id: str = ""
-        self.hg = Hashgraph(participants, store, commit_callback)
+        if engine == "tpu":
+            # Device-backed consensus behind the same seam — the
+            # JaxStore-sibling integration of SURVEY §7 step 3.
+            from ..hashgraph.tpu_graph import TpuHashgraph
+
+            self.hg: Hashgraph = TpuHashgraph(participants, store, commit_callback)
+        elif engine == "host":
+            self.hg = Hashgraph(participants, store, commit_callback)
+        else:
+            raise ValueError(f"unknown consensus engine {engine!r}")
         self.participants = participants
         self.reverse_participants = {pid: pk for pk, pid in participants.items()}
         self.head = ""

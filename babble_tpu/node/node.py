@@ -60,7 +60,11 @@ class Node:
 
         self.commit_ch: "queue.Queue[Block]" = queue.Queue(400)
         pmap = store.participants()
-        self.core = Core(id, key, pmap, store, commit_callback=self.commit_ch.put)
+        self.core = Core(
+            id, key, pmap, store,
+            commit_callback=self.commit_ch.put,
+            engine=getattr(conf, "engine", "host"),
+        )
         self.core_lock = threading.Lock()
 
         self.peer_selector = RandomPeerSelector(participants, self.local_addr)

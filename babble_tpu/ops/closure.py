@@ -42,25 +42,15 @@ def _apply_chunks(block: int, n: int) -> int:
     return chunks
 
 
-@functools.partial(jax.jit, static_argnames=("n", "block"))
-def compute_coordinates(self_parent, other_parent, creator, index, root_base,
-                        *, n, block):
-    """la[x, i] = index of x's latest ancestor created by i (-1 none);
-    rbase[x] = max over ancestors-incl-self of root_base (-1 none).
-
-    Inputs are [E_pad + 1] int32 with E_pad a multiple of `block` and a
-    sentinel row at id E_pad; pad events carry sp=op=-1, index=-1,
-    root_base=-1 and produce inert rows. Returns (la[E_pad, n],
-    rbase[E_pad]).
-    """
+def make_block_body(self_parent, other_parent, creator, index, root_base,
+                    *, n, block):
+    """The per-block closure step over [cap+1]-shaped inputs, shared by
+    the one-shot kernel below and the incremental carry kernel
+    (ops/incremental.py). Returns body(b, (la, rb)) -> (la, rb)."""
     e_pad = self_parent.shape[0] - 1
-    nblocks = e_pad // block
     log2b = max(int(np.ceil(np.log2(block))), 1)
     chunks = _apply_chunks(block, n)
     rows_per_chunk = block // chunks
-
-    la = jnp.full((e_pad + 1, n), -1, dtype=jnp.int32)
-    rb = jnp.full((e_pad + 1,), -1, dtype=jnp.int32)
     eye = jnp.eye(block, dtype=jnp.float32)
     rows = jnp.arange(block)
 
@@ -113,6 +103,26 @@ def compute_coordinates(self_parent, other_parent, creator, index, root_base,
         rb = lax.dynamic_update_slice(rb, out_rb, (s,))
         return la, rb
 
+    return body
+
+
+@functools.partial(jax.jit, static_argnames=("n", "block"))
+def compute_coordinates(self_parent, other_parent, creator, index, root_base,
+                        *, n, block):
+    """la[x, i] = index of x's latest ancestor created by i (-1 none);
+    rbase[x] = max over ancestors-incl-self of root_base (-1 none).
+
+    Inputs are [E_pad + 1] int32 with E_pad a multiple of `block` and a
+    sentinel row at id E_pad; pad events carry sp=op=-1, index=-1,
+    root_base=-1 and produce inert rows. Returns (la[E_pad, n],
+    rbase[E_pad]).
+    """
+    e_pad = self_parent.shape[0] - 1
+    nblocks = e_pad // block
+    la = jnp.full((e_pad + 1, n), -1, dtype=jnp.int32)
+    rb = jnp.full((e_pad + 1,), -1, dtype=jnp.int32)
+    body = make_block_body(self_parent, other_parent, creator, index,
+                           root_base, n=n, block=block)
     la, rb = lax.fori_loop(0, nblocks, body, (la, rb))
     return la[:e_pad], rb[:e_pad]
 

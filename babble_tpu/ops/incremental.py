@@ -1,0 +1,496 @@
+"""Incremental device-backed consensus: append events, re-run only the
+undecided tip.
+
+The reference inserts one event at a time and re-runs
+DivideRounds/DecideFame/FindOrder over its undetermined queue
+(reference hashgraph/hashgraph.go:356-401, 616-858). This module is the
+TPU-native equivalent — an append-only device DAG with amortized
+per-sync work instead of full-DAG recompute:
+
+  coordinates   the frozen prefix stays resident in HBM; only new
+                closure blocks run (ops/closure.py block body over a
+                donated carry), so per-sync cost scales with the new
+                events, not E.
+  rounds        the witness frontier (ops/frontier.py) restarts at the
+                first round that can still gain members. Rows below are
+                provably frozen: chain positions only append, and
+                strongly-see of an existing event is stable under new
+                descendants (a new first-descendant index always
+                exceeds the old event's last-ancestor index).
+  fame          kernels.decide_fame over a round window starting at the
+                first undecided round. Window-relative round numbers
+                preserve the vote/coin semantics exactly: diff = j - rx
+                is shift-invariant, so first-round votes and coin
+                rounds (diff % n) land identically.
+  round recv    a windowed sweep over candidate rounds, gated by a
+                host-maintained eligibility mask that mirrors the
+                reference's undecided-rounds bookkeeping — including
+                the straggler quirk: a witness discovered in a round
+                already removed from the undecided list stays
+                UNDEFINED forever and poisons that round's
+                witnesses_decided (hashgraph.go:629-637, 762-764).
+
+Capacity, chain length, and round windows are bucketed to powers of two
+so steady-state syncs never recompile. Base roots only (index 0 chain
+starts): frame-reset graphs with offset indexes stay on the host engine
+(the node's fast-sync path is a reference-parity stub anyway,
+node/node.go:432-441).
+"""
+
+from __future__ import annotations
+
+import bisect
+import functools
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from . import closure, frontier, kernels
+from .kernels import FAME_TRUE, FAME_UNDEFINED, INT32_MAX, ZERO_TS_RANK
+
+# Go's zero time (0001-01-01T00:00:00Z) in ns — the value MedianTimestamp
+# substitutes for unreached witnesses (reference hashgraph.go:860-868).
+# It overflows int64, so device/host arrays store CTS_SENTINEL (which
+# still sorts below every real timestamp) and the Python-level RunDelta
+# carries the true value.
+ZERO_TIME_NS = -62135596800 * 1_000_000_000
+CTS_SENTINEL = np.iinfo(np.int64).min
+
+
+def _pow2(x: int, floor: int = 8) -> int:
+    p = floor
+    while p < x:
+        p *= 2
+    return p
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n", "block"), donate_argnums=(0, 1)
+)
+def _closure_update(la, rb, self_parent, other_parent, creator, index,
+                    root_base, b0, b1, *, n, block):
+    """Run the closure block body over blocks [b0, b1) against donated
+    coordinate carries la [cap+1, n] / rb [cap+1]."""
+    body = closure.make_block_body(
+        self_parent, other_parent, creator, index, root_base,
+        n=n, block=block)
+    return lax.fori_loop(b0, b1, body, (la, rb))
+
+
+@functools.partial(jax.jit, static_argnames=("n", "iw"))
+def _decide_rr_window(rounds, rr_prev, wt_win, famous_win, elig, la, fd,
+                      creator, index, chain_rank, i0, *, n, iw):
+    """kernels.decide_round_received restricted to candidate rounds
+    [i0, i0+iw). `elig` [iw] is the host-computed reference gating
+    (round fully decided AND every earlier round decided,
+    hashgraph.go:762-764); `rr_prev` keeps already-assigned rounds
+    (assignments are final). Returns (rr, cts_rank) with cts computed
+    only for newly-assigned events."""
+    e = rounds.shape[0]
+    k = chain_rank.shape[1]
+    wt_valid = wt_win >= 0
+    wt_safe = jnp.where(wt_valid, wt_win, 0)
+    fmask = (famous_win == FAME_TRUE) & wt_valid
+    fcnt = fmask.sum(1)
+    idx_w = jnp.where(wt_valid, index[wt_safe], -1)
+    creator_e = creator[:e]
+    index_e = index[:e]
+
+    def step(t, rr):
+        i = i0 + t
+        la_w = la[wt_safe[t]]  # [n(w), n]
+        see_wx = la_w[:, creator_e] >= index_e[None, :]  # [n(w), E]
+        s_cnt = (see_wx & fmask[t][:, None]).sum(0)
+        ok = elig[t] & (s_cnt > fcnt[t] // 2) & (i > rounds) & (rr < 0)
+        return jnp.where(ok, i, rr)
+
+    rr = lax.fori_loop(0, iw, step, rr_prev)
+    newly = (rr >= 0) & (rr_prev < 0)
+
+    t_sel = jnp.clip(rr - i0, 0, iw - 1)
+    w_sel = wt_safe[t_sel]  # [E, n]
+    fm_sel = fmask[t_sel]
+    idxw_sel = idx_w[t_sel]
+    see_sel = la[w_sel, creator_e[:, None]] >= index_e[:, None]
+    s_mask = see_sel & fm_sel
+    s_cnt = s_mask.sum(1)
+    valid_t = fd <= idxw_sel  # first descendant reaches the witness
+    ts_fd = chain_rank[jnp.arange(n)[None, :], jnp.clip(fd, 0, k - 1)]
+    tsv = jnp.where(valid_t, ts_fd, ZERO_TS_RANK)
+    tvals = jnp.where(s_mask, tsv, INT32_MAX)
+    sorted_t = jnp.sort(tvals, axis=1)
+    med = jnp.take_along_axis(sorted_t, (s_cnt // 2)[:, None], axis=1)[:, 0]
+    cts = jnp.where(newly, med, ZERO_TS_RANK)
+    return rr, cts
+
+
+@dataclass
+class RunDelta:
+    """What one run() call newly decided — the exact shape of the
+    reference's per-RunConsensus side effects (node/core.go:277-296)."""
+
+    new_rounds: List[Tuple[int, int, bool]] = field(default_factory=list)
+    # (round, eid, famous) in host decide_fame order
+    fame_updates: List[Tuple[int, int, bool]] = field(default_factory=list)
+    # (eid, round_received, consensus_ts_ns), unsorted
+    new_received: List[Tuple[int, int, int]] = field(default_factory=list)
+    newly_decided_rounds: List[int] = field(default_factory=list)
+    last_consensus_round: Optional[int] = None
+    last_commited_round_events: int = 0
+
+
+class IncrementalEngine:
+    """Growable device-resident DAG + amortized consensus pipeline.
+
+    append()/append_batch() stage events on the host (numpy mirrors with
+    capacity doubling); run() executes the incremental pipeline and
+    returns a RunDelta. Query helpers serve from the host mirrors of the
+    last run's results.
+    """
+
+    def __init__(self, n: int, root_round=None, *, capacity: int = 256,
+                 block: int = 256, k_capacity: int = 64, rc: int = 64):
+        if n < 1:
+            raise ValueError("need at least one participant")
+        self.n = n
+        self.sm = 2 * n // 3 + 1
+        self.block = block
+        self.rc = rc
+        self.root_round = (
+            np.full(n, -1, np.int32) if root_round is None
+            else np.asarray(root_round, np.int32).copy()
+        )
+        self.rho_min = int(self.root_round.min()) + 1
+        self.cap = max(_pow2(capacity, block), block)
+        self.kcap = _pow2(k_capacity, 8)
+
+        self.e = 0
+        c1 = self.cap + 1
+        self.self_parent = np.full(c1, -1, np.int32)
+        self.other_parent = np.full(c1, -1, np.int32)
+        self.creator = np.zeros(c1, np.int32)
+        self.index = np.full(c1, -1, np.int32)
+        self.coin = np.zeros(c1, np.int8)
+        self.root_base = np.full(c1, -1, np.int32)
+        self.ts_ns = np.zeros(self.cap, np.int64)
+        self.chain = np.full((n, self.kcap), -1, np.int32)
+        self.chain_len = np.zeros(n, np.int32)
+
+        # Results (host mirrors, -1 = undetermined).
+        self.rounds = np.zeros(self.cap, np.int32)
+        self.witness = np.zeros(self.cap, np.bool_)
+        self.rr = np.zeros(self.cap, np.int32)  # pad rows 0: never assigned
+        self.cts_ns = np.zeros(self.cap, np.int64)
+
+        # Device carries.
+        self._la = jnp.full((c1, n), -1, jnp.int32)
+        self._rb = jnp.full((c1,), -1, jnp.int32)
+        self._frozen_blocks = 0
+
+        # Frontier checkpoint: relative rows rho_min + t.
+        self._fr_table = np.zeros((0, n), np.int32)
+        self._wt_table = np.full((0, n), -1, np.int32)
+        self._chain_len_prev = np.zeros(n, np.int32)
+
+        # Fame / round-received bookkeeping (reference
+        # hashgraph.go:629-637: queued-once, removed-once).
+        self.famous = np.zeros((0, n), np.int32)  # [r_total, n] trilean
+        self.undecided_rounds: List[int] = [0]
+        self._queued_rounds = {0}
+        self._prev_first_undec = 0
+        self.last_consensus_round: Optional[int] = None
+
+        self._new_since_run: List[int] = []
+        self._empty_delta_ok = False  # True when state is at a fixpoint
+
+    # -- append ------------------------------------------------------------
+
+    def append(self, sp: int, op: int, creator: int, index: int,
+               coin: bool, ts_ns: int) -> int:
+        """Append one event; parents are engine ids (-1 = root). Returns
+        the event id. Enforces the reference's insert discipline: index
+        must extend the creator's chain contiguously (fork/foreign
+        events are rejected upstream, hashgraph.go:404-445)."""
+        if index != int(self.chain_len[creator]):
+            raise ValueError(
+                f"non-contiguous index {index} for creator {creator} "
+                f"(chain length {int(self.chain_len[creator])})"
+            )
+        expect_sp = self.chain[creator, index - 1] if index > 0 else -1
+        if sp != int(expect_sp):
+            raise ValueError("self-parent is not the creator's head")
+        if self.e == self.cap:
+            self._grow_capacity()
+        if index == self.kcap:
+            self._grow_chains()
+        i = self.e
+        self.self_parent[i] = sp
+        self.other_parent[i] = op
+        self.creator[i] = creator
+        self.index[i] = index
+        self.coin[i] = 1 if coin else 0
+        self.root_base[i] = (
+            self.root_round[creator] + 1 if (sp < 0 or op < 0) else -1
+        )
+        self.ts_ns[i] = ts_ns
+        self.chain[creator, index] = i
+        self.chain_len[creator] += 1
+        self.rounds[i] = -1
+        self.witness[i] = False
+        self.rr[i] = -1
+        self.cts_ns[i] = CTS_SENTINEL
+        self.e += 1
+        self._new_since_run.append(i)
+        self._empty_delta_ok = False
+        return i
+
+    def append_batch(self, sp, op, creator, index, coin, ts_ns) -> None:
+        for k in range(len(sp)):
+            self.append(int(sp[k]), int(op[k]), int(creator[k]),
+                        int(index[k]), bool(coin[k]), int(ts_ns[k]))
+
+    def _grow_capacity(self) -> None:
+        new_cap = self.cap * 2
+        c1 = new_cap + 1
+
+        def regrow(a, fill, dtype):
+            out = np.full(c1, fill, dtype)
+            out[: self.cap] = a[: self.cap]
+            return out
+
+        self.self_parent = regrow(self.self_parent, -1, np.int32)
+        self.other_parent = regrow(self.other_parent, -1, np.int32)
+        self.creator = regrow(self.creator, 0, np.int32)
+        self.index = regrow(self.index, -1, np.int32)
+        self.coin = regrow(self.coin, 0, np.int8)
+        self.root_base = regrow(self.root_base, -1, np.int32)
+        for name, fill, dtype in (
+            ("ts_ns", 0, np.int64), ("rounds", 0, np.int32),
+            ("witness", False, np.bool_), ("rr", 0, np.int32),
+            ("cts_ns", 0, np.int64),
+        ):
+            out = np.full(new_cap, fill, dtype)
+            out[: self.cap] = getattr(self, name)[: self.cap]
+            setattr(self, name, out)
+        la = np.full((c1, self.n), -1, np.int32)
+        la[: self.cap] = np.asarray(self._la[: self.cap])
+        rb = np.full(c1, -1, np.int32)
+        rb[: self.cap] = np.asarray(self._rb[: self.cap])
+        self._la = jnp.asarray(la)
+        self._rb = jnp.asarray(rb)
+        self.cap = new_cap
+
+    def _grow_chains(self) -> None:
+        new_k = self.kcap * 2
+        chain = np.full((self.n, new_k), -1, np.int32)
+        chain[:, : self.kcap] = self.chain
+        self.chain = chain
+        self.kcap = new_k
+
+    # -- the incremental pipeline -----------------------------------------
+
+    def run(self) -> RunDelta:
+        if self.e == 0 or (self._empty_delta_ok and not self._new_since_run):
+            return RunDelta(last_consensus_round=self.last_consensus_round)
+        n, sm, e = self.n, self.sm, self.e
+
+        sp_d = jnp.asarray(self.self_parent)
+        op_d = jnp.asarray(self.other_parent)
+        cr_d = jnp.asarray(self.creator)
+        idx_d = jnp.asarray(self.index)
+        coin_d = jnp.asarray(self.coin)
+        rb0_d = jnp.asarray(self.root_base)
+        chain_d = jnp.asarray(self.chain)
+        chain_len_d = jnp.asarray(self.chain_len)
+
+        # 1. Coordinates: only blocks the frozen prefix doesn't cover.
+        nb = (e + self.block - 1) // self.block
+        self._la, self._rb = _closure_update(
+            self._la, self._rb, sp_d, op_d, cr_d, idx_d, rb0_d,
+            jnp.int32(self._frozen_blocks), jnp.int32(nb),
+            n=n, block=self.block)
+        self._frozen_blocks = e // self.block
+        la = self._la[: self.cap]
+        rb = self._rb[: self.cap]
+
+        # 2. First descendants (closed form, full recompute: old events'
+        # entries legitimately change when descendants arrive).
+        fd = kernels.compute_first_descendants(
+            la, cr_d, idx_d, chain_d, chain_len_d, n=n)
+
+        # 3. Witness frontier, warm-started at the first growable row.
+        rel_rows = len(self._fr_table)
+        if rel_rows:
+            growable = (
+                self._fr_table >= self._chain_len_prev[None, :]
+            ).any(axis=1)
+            t0 = int(np.argmax(growable)) if growable.any() else rel_rows
+        else:
+            t0 = 0
+        chain_la, chain_rbase = frontier.build_chain_tables(
+            la, rb, chain_d, n=n)
+        if t0 > 0:
+            wt_prev = jnp.asarray(self._wt_table[t0 - 1])
+            fr_prev = jnp.asarray(self._fr_table[t0 - 1])
+        else:
+            wt_prev = jnp.full((n,), -1, jnp.int32)
+            fr_prev = jnp.zeros((n,), jnp.int32)
+        wt_rows = [self._wt_table[:t0]]
+        fr_rows = [self._fr_table[:t0]]
+        rho0 = self.rho_min + t0
+        while True:
+            wt_o, fr_o, act, wt_prev, fr_prev = frontier.frontier_chunk(
+                chain_la, chain_rbase, chain_len_d, la, fd, rb, chain_d,
+                wt_prev, fr_prev, jnp.int32(rho0), n=n, sm=sm, rc=self.rc)
+            act_np = np.asarray(act)
+            wt_rows.append(np.asarray(wt_o))
+            fr_rows.append(np.asarray(fr_o))
+            if not bool(act_np[-1]):
+                break
+            rho0 += self.rc
+        fr_all = np.concatenate(fr_rows, axis=0)
+        wt_all = np.concatenate(wt_rows, axis=0)
+        active = (fr_all < self.chain_len[None, :]).any(axis=1)
+        n_rows = int(np.nonzero(active)[0][-1]) + 1 if active.any() else 0
+        self._fr_table = fr_all[:n_rows]
+        self._wt_table = wt_all[:n_rows]
+        self._chain_len_prev = self.chain_len.copy()
+        r_total = self.rho_min + n_rows
+        wt_abs = np.full((r_total, n), -1, np.int32)
+        if n_rows:
+            wt_abs[self.rho_min:] = self._wt_table
+        if self.famous.shape[0] < r_total:
+            grown = np.zeros((r_total, n), np.int32)
+            grown[: self.famous.shape[0]] = self.famous
+            self.famous = grown
+
+        delta = RunDelta()
+
+        # 4. Rounds + witness flags for the new events (host closed form
+        # over the frontier table: round = rho_min - 1 + #rows whose
+        # frontier position <= the event's chain position).
+        min_new_round = None
+        for i in self._new_since_run:
+            c, pos = int(self.creator[i]), int(self.index[i])
+            rnd = self.rho_min - 1 + int(
+                np.searchsorted(self._fr_table[:, c], pos, side="right"))
+            sp = int(self.self_parent[i])
+            wit = sp < 0 or rnd > int(self.rounds[sp])
+            self.rounds[i] = rnd
+            self.witness[i] = wit
+            delta.new_rounds.append((i, rnd, wit))
+            if min_new_round is None or rnd < min_new_round:
+                min_new_round = rnd
+            if rnd not in self._queued_rounds:
+                self._queued_rounds.add(rnd)
+                bisect.insort(self.undecided_rounds, rnd)
+
+        # 5. Fame over the window [rx0, r_total).
+        if self.undecided_rounds and self.undecided_rounds[0] < r_total:
+            rx0 = self.undecided_rounds[0]
+            rw = _pow2(r_total - rx0)
+            wt_win = np.full((rw, n), -1, np.int32)
+            wt_win[: r_total - rx0] = wt_abs[rx0:]
+            famous_win = np.asarray(kernels.decide_fame(
+                jnp.asarray(wt_win), la, fd, idx_d, coin_d,
+                n=n, sm=sm, r=rw))
+            for rho in list(self.undecided_rounds):
+                if rho >= r_total:
+                    continue
+                t = rho - rx0
+                row_decided = True
+                for c in range(n):
+                    if wt_abs[rho, c] < 0:
+                        continue
+                    if self.famous[rho, c] == FAME_UNDEFINED:
+                        f = int(famous_win[t, c])
+                        if f != FAME_UNDEFINED:
+                            self.famous[rho, c] = f
+                            delta.fame_updates.append(
+                                (rho, int(wt_abs[rho, c]), f == FAME_TRUE))
+                    if self.famous[rho, c] == FAME_UNDEFINED:
+                        row_decided = False
+                if row_decided:
+                    self.undecided_rounds.remove(rho)
+                    delta.newly_decided_rounds.append(rho)
+                    if (self.last_consensus_round is None
+                            or rho > self.last_consensus_round):
+                        self.last_consensus_round = rho
+                        delta.last_commited_round_events = int(
+                            (self.rounds[:e] == rho - 1).sum())
+        delta.last_consensus_round = self.last_consensus_round
+
+        # 6. Round received over the window [i0, r_total).
+        first_undec = (
+            self.undecided_rounds[0] if self.undecided_rounds else r_total)
+        i0 = self._prev_first_undec
+        if min_new_round is not None:
+            i0 = min(i0, min_new_round + 1)
+        self._prev_first_undec = first_undec
+        if i0 < r_total:
+            iw = _pow2(r_total - i0)
+            wt_win = np.full((iw, n), -1, np.int32)
+            fam_win = np.zeros((iw, n), np.int32)
+            span = r_total - i0
+            wt_win[:span] = wt_abs[i0:]
+            fam_win[:span] = self.famous[i0:r_total]
+            decided_row = np.ones(r_total, np.bool_)
+            for rho in range(r_total):
+                slots = wt_abs[rho] >= 0
+                decided_row[rho] = not (
+                    slots & (self.famous[rho] == FAME_UNDEFINED)).any()
+            elig = np.zeros(iw, np.bool_)
+            for t in range(span):
+                i = i0 + t
+                elig[t] = bool(decided_row[i]) and first_undec > i
+
+            # Timestamp ranks are global-sort positions, recomputed per
+            # call because new timestamps interleave with old ones.
+            ts_values, inv = np.unique(self.ts_ns[:e], return_inverse=True)
+            chain_rank = np.full((n, self.kcap), -1, np.int32)
+            valid = self.chain >= 0
+            safe = np.where(valid, self.chain, 0)
+            ranks = inv.astype(np.int32)
+            chain_rank[valid] = ranks[safe[valid]]
+
+            rr_new, cts_rank = _decide_rr_window(
+                jnp.asarray(self.rounds[: self.cap]),
+                jnp.asarray(self.rr[: self.cap]),
+                jnp.asarray(wt_win), jnp.asarray(fam_win),
+                jnp.asarray(elig), la, fd, cr_d, idx_d,
+                jnp.asarray(chain_rank), jnp.int32(i0), n=n, iw=iw)
+            rr_np = np.asarray(rr_new)
+            cts_np = np.asarray(cts_rank)
+            newly = (rr_np >= 0) & (self.rr[: self.cap] < 0)
+            newly[e:] = False
+            for i in np.nonzero(newly)[0]:
+                rr_i = int(rr_np[i])
+                rank = int(cts_np[i])
+                self.rr[i] = rr_i
+                if rank == ZERO_TS_RANK:
+                    self.cts_ns[i] = CTS_SENTINEL
+                    ns = ZERO_TIME_NS
+                else:
+                    ns = int(ts_values[rank])
+                    self.cts_ns[i] = ns
+                delta.new_received.append((int(i), rr_i, ns))
+
+        self._new_since_run = []
+        self._empty_delta_ok = True
+        return delta
+
+    # -- queries -----------------------------------------------------------
+
+    def round_of(self, eid: int) -> int:
+        return int(self.rounds[eid])
+
+    def witness_table(self) -> np.ndarray:
+        r_total = self.rho_min + len(self._wt_table)
+        wt_abs = np.full((r_total, self.n), -1, np.int32)
+        if len(self._wt_table):
+            wt_abs[self.rho_min:] = self._wt_table
+        return wt_abs

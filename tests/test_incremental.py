@@ -1,0 +1,153 @@
+"""Incremental device engine + TpuHashgraph integration tests.
+
+Three layers of parity, mirroring the reference's oracle strategy
+(hashgraph_test.go fixtures -> core_test.go playbooks -> node_test.go
+checkGossip):
+
+1. IncrementalEngine fed in batches must equal the one-shot full
+   pipeline bit-for-bit (rounds, witnesses, fame, round-received,
+   consensus timestamps) across capacity/chain-bucket growth.
+2. TpuHashgraph driven event-by-event must equal the incremental host
+   engine on the reference fixture graphs: same rounds, witness sets,
+   fame trileans, consensus order, and block hashes.
+3. The live gossip runtime (reference node_test.go:396-420) must
+   converge with the device engine deciding consensus.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from babble_tpu.hashgraph import InmemStore
+from babble_tpu.hashgraph.round_info import Trilean
+from babble_tpu.hashgraph.tpu_graph import TpuHashgraph
+from babble_tpu.ops.dag import synthetic_dag
+from babble_tpu.ops.incremental import CTS_SENTINEL, IncrementalEngine
+from babble_tpu.ops.pipeline import run_pipeline
+
+from fixtures import (
+    build_consensus_graph,
+    build_funky_graph,
+    build_round_graph,
+)
+from test_node import check_gossip, make_nodes, run_gossip
+
+CACHE = 10000
+
+
+@pytest.mark.parametrize(
+    "n,e,bs", [(8, 300, 37), (5, 97, 10)], ids=["n8", "n5"]
+)
+def test_engine_matches_full_pipeline(n, e, bs):
+    """Batched ingest with run() between batches == one-shot recompute,
+    across capacity doubling and chain-bucket growth."""
+    dag, _ = synthetic_dag(n, e, seed=3)
+    eng = IncrementalEngine(n, capacity=64, block=64, k_capacity=8)
+    k = 0
+    while k < e:
+        hi = min(k + bs, e)
+        eng.append_batch(
+            dag.self_parent[k:hi], dag.other_parent[k:hi],
+            dag.creator[k:hi], dag.index[k:hi], dag.coin[k:hi],
+            np.arange(k, hi))
+        eng.run()
+        k = hi
+
+    rounds, wit, wt, famous, rr, cts = map(
+        np.asarray, run_pipeline(dag, engine="wavefront"))
+    assert (eng.rounds[:e] == rounds).all()
+    assert (eng.witness[:e] == wit).all()
+    assert (eng.rr[:e] == rr).all()
+    wt_abs = eng.witness_table()
+    rt = wt_abs.shape[0]
+    assert (wt_abs == wt[:rt]).all()
+    assert (wt[rt:] == -1).all()
+    assert (eng.famous == famous[:rt]).all()
+    dec = rr >= 0
+    # pipeline cts are ranks into dag.ts_values == arange(e); -1 = zero time
+    cts_ns = np.where(cts < 0, CTS_SENTINEL, cts.astype(np.int64))
+    assert (eng.cts_ns[:e][dec] == cts_ns[dec]).all()
+
+
+@pytest.mark.parametrize(
+    "build,every",
+    [(build_round_graph, 4), (build_consensus_graph, 7),
+     (build_funky_graph, 3)],
+    ids=["round", "consensus", "funky"],
+)
+def test_tpu_graph_matches_host(build, every):
+    """TpuHashgraph with interleaved run_consensus calls reproduces the
+    host engine's rounds, witness sets, fame, consensus order, and
+    blocks on the reference fixture graphs."""
+    h, b = build()
+    h.divide_rounds()
+    h.decide_fame()
+    h.find_order()
+
+    participants = b.participants()
+    t = TpuHashgraph(participants, InmemStore(participants, CACHE),
+                     capacity=64, block=64)
+    for k, ev in enumerate(b.ordered_events):
+        t.insert_event(ev, True)
+        if (k + 1) % every == 0:
+            t.run_consensus()
+    t.run_consensus()
+
+    for ev in b.ordered_events:
+        x = ev.hex()
+        assert t.round(x) == h.round(x), b.get_name(x)
+        assert t.witness(x) == h.witness(x), b.get_name(x)
+        assert t.round_received(x) == h.round_received(x), b.get_name(x)
+    for r in range(h.store.last_round() + 1):
+        assert set(t.store.round_witnesses(r)) == set(
+            h.store.round_witnesses(r)), f"round {r}"
+        hri = h.store.get_round(r)
+        tri = t.store.get_round(r)
+        for w in hri.witnesses():
+            assert tri.events[w].famous == hri.events[w].famous, (
+                f"fame mismatch {b.get_name(w)} round {r}")
+    assert t.consensus_events() == h.consensus_events()
+    assert t.last_consensus_round == h.last_consensus_round
+    assert t.pending_loaded_events == h.pending_loaded_events
+    assert t.consensus_transactions == h.consensus_transactions
+    assert set(t.undetermined_events) == set(h.undetermined_events)
+    for r in range(h.store.last_round() + 1):
+        try:
+            hb = h.store.get_block(r)
+        except Exception:
+            continue
+        tb = t.store.get_block(r)
+        assert tb.hash() == hb.hash(), f"block {r}"
+
+
+def test_tpu_graph_consensus_timestamps():
+    """Consensus timestamps (median over famous-witness first
+    descendants) must match the host engine exactly — they are the
+    second consensus sort key."""
+    h, b = build_consensus_graph()
+    h.divide_rounds()
+    h.decide_fame()
+    h.find_order()
+    participants = b.participants()
+    t = TpuHashgraph(participants, InmemStore(participants, CACHE),
+                     capacity=64, block=64)
+    for ev in b.ordered_events:
+        t.insert_event(ev, True)
+    t.run_consensus()
+    for x in h.consensus_events():
+        he = h.store.get_event(x)
+        te = t.store.get_event(x)
+        assert te.consensus_timestamp.ns == he.consensus_timestamp.ns, (
+            b.get_name(x))
+
+
+def test_gossip_tpu_engine():
+    """4-node gossip over the inmem transport with the device engine
+    deciding consensus — reference node_test.go:396-407 with the
+    JaxStore-sibling integration (SURVEY §7 step 3)."""
+    nodes = make_nodes(4, "inmem", engine="tpu")
+    for node in nodes:
+        assert isinstance(node.core.hg, TpuHashgraph)
+    run_gossip(nodes, target_round=5, timeout=120.0)
+    check_gossip(nodes)

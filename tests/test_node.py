@@ -123,7 +123,7 @@ def test_core_over_sync_limit():
 # ---------------------------------------------------------------- nodes
 
 
-def make_nodes(n, transport):
+def make_nodes(n, transport, engine="host"):
     if transport == "tcp":
         transports = [
             TCPTransport("127.0.0.1:0", timeout=2.0) for _ in range(n)
@@ -143,6 +143,7 @@ def make_nodes(n, transport):
     nodes = []
     for i, (key, peer) in enumerate(entries):
         conf = fast_config(heartbeat=0.01 if transport == "inmem" else 0.05)
+        conf.engine = engine
         store = InmemStore(participants, CACHE)
         proxy = InmemAppProxy()
         node = Node(conf, i, key, peers, store, by_addr[peer.net_addr], proxy)
