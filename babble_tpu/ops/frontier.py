@@ -71,23 +71,22 @@ def build_chain_tables(la, rbase, chain, *, n):
     return chain_la, chain_rbase
 
 
-@functools.partial(jax.jit, static_argnames=("n", "sm", "rc"))
-def frontier_chunk(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
-                   wt_prev, fr_prev, rho0, *, n, sm, rc):
-    """Advance the witness frontier by `rc` rounds starting at rho0.
+def make_round_step(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
+                    *, n, sm, pos2k=None):
+    """One frontier round: step(rho, wt_prev, fr_prev) ->
+    (wt_row, fr_unclamped, fr_clamped, any_candidate). Shared by the
+    chunked host driver below and the single-dispatch while-loop sweep
+    (used by ops/incremental.py).
 
-    wt_prev: [n] witness event ids of round rho0-1 (-1 none);
-    fr_prev: [n] first chain position with round >= rho0-1.
-    Returns (wt_out[rc, n], fr_out[rc, n], active[rc], wt_last, fr_last).
-    """
+    With `pos2k` (the kernels.first_descendant_cube [c, i, t] table),
+    the per-round strongly-see searchsorted collapses to a gather:
+    k_ci[c, i, w] = pos2k[c, i, fd[w, i]] — both sides are positions on
+    chain i, so the precomputed inverse lookup answers every round."""
     k_cap = chain_la.shape[1]
     cols = jnp.transpose(chain_la, (0, 2, 1))  # [c, i, K] each sorted
     cc = n // _chain_chunks(n)
 
-    def round_step(t, carry):
-        wt_prev, fr_prev, wt_out, fr_out, act_out = carry
-        rho = rho0 + t
-
+    def step(rho, wt_prev, fr_prev):
         # k1: first chain position whose propagated root contribution
         # reaches rho (chain_rbase is monotone along the chain).
         k1 = jax.vmap(lambda col: jnp.searchsorted(col, rho))(chain_rbase)
@@ -96,25 +95,34 @@ def frontier_chunk(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
         # k2: first position strongly seeing >= sm of wt_prev.
         wt_valid = wt_prev >= 0
         fdw = fd[jnp.where(wt_valid, wt_prev, 0)]  # [w, i]
-        targets = jnp.broadcast_to(fdw.T[None], (cc, n, n))
 
         # first_k_ss[c, w] = sm-th smallest over i of
         # k_ci[c, i, w] = first k with chain_la[c, k, i] >= fd[w, i],
         # computed in chain chunks to bound the [cc, n, n] cube.
-        def chain_chunk(g, acc):
-            c0 = g * cc
-            cols_g = lax.dynamic_slice(cols, (c0, 0, 0), (cc, n, k_cap))
-            len_g = lax.dynamic_slice(chain_len, (c0,), (cc,))
-            k_ci = jax.vmap(  # over chains c
-                jax.vmap(jnp.searchsorted, in_axes=(0, 0))  # over coords i
-            )(cols_g, targets).astype(jnp.int32)
-            k_ci = jnp.where(k_ci < len_g[:, None, None], k_ci, INT32_MAX)
-            part = jnp.sort(k_ci, axis=1)[:, sm - 1, :]  # [cc, w]
-            return lax.dynamic_update_slice(acc, part, (c0, 0))
+        if pos2k is not None:
+            t_idx = jnp.clip(fdw.T, 0, k_cap - 1)  # [i, w]
+            k_ci_full = jnp.take_along_axis(
+                pos2k, jnp.broadcast_to(t_idx[None], (n, n, n)), axis=2)
+            k_ci_full = jnp.where(
+                (fdw.T < INT32_MAX)[None], k_ci_full, INT32_MAX)
+            first_k_ss = jnp.sort(k_ci_full, axis=1)[:, sm - 1, :]
+        else:
+            targets = jnp.broadcast_to(fdw.T[None], (cc, n, n))
 
-        first_k_ss = lax.fori_loop(
-            0, n // cc, chain_chunk,
-            jnp.full((n, n), INT32_MAX, dtype=jnp.int32))
+            def chain_chunk(g, acc):
+                c0 = g * cc
+                cols_g = lax.dynamic_slice(cols, (c0, 0, 0), (cc, n, k_cap))
+                len_g = lax.dynamic_slice(chain_len, (c0,), (cc,))
+                k_ci = jax.vmap(  # over chains c
+                    jax.vmap(jnp.searchsorted, in_axes=(0, 0))  # over coords
+                )(cols_g, targets).astype(jnp.int32)
+                k_ci = jnp.where(k_ci < len_g[:, None, None], k_ci, INT32_MAX)
+                part = jnp.sort(k_ci, axis=1)[:, sm - 1, :]  # [cc, w]
+                return lax.dynamic_update_slice(acc, part, (c0, 0))
+
+            first_k_ss = lax.fori_loop(
+                0, n // cc, chain_chunk,
+                jnp.full((n, n), INT32_MAX, dtype=jnp.int32))
         first_k_ss = jnp.where(wt_valid[None, :], first_k_ss, INT32_MAX)
         # k2[c] = sm-th smallest over w (needs sm witnesses seen)
         k2 = jnp.sort(first_k_ss, axis=1)[:, sm - 1]
@@ -134,10 +142,30 @@ def frontier_chunk(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
         rb_c = jnp.where(cand_valid, rbase[safe], -1)
         skip = (rb_c >= rho + 1) | (ss_cc.sum(-1) >= sm)
         wt_row = jnp.where(cand_valid & ~skip, cand, -1)
+        return wt_row, fr, fr_c, cand_valid.any()
 
+    return step
+
+
+@functools.partial(jax.jit, static_argnames=("n", "sm", "rc"))
+def frontier_chunk(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
+                   wt_prev, fr_prev, rho0, *, n, sm, rc):
+    """Advance the witness frontier by `rc` rounds starting at rho0.
+
+    wt_prev: [n] witness event ids of round rho0-1 (-1 none);
+    fr_prev: [n] first chain position with round >= rho0-1.
+    Returns (wt_out[rc, n], fr_out[rc, n], active[rc], wt_last, fr_last).
+    """
+    k_cap = chain_la.shape[1]
+    step = make_round_step(chain_la, chain_rbase, chain_len, la, fd, rbase,
+                           chain, n=n, sm=sm)
+
+    def round_step(t, carry):
+        wt_prev, fr_prev, wt_out, fr_out, act_out = carry
+        wt_row, fr, fr_c, any_cand = step(rho0 + t, wt_prev, fr_prev)
         wt_out = wt_out.at[t].set(wt_row)
         fr_out = fr_out.at[t].set(fr_c)
-        act_out = act_out.at[t].set(cand_valid.any())
+        act_out = act_out.at[t].set(any_cand)
         return wt_row, fr, wt_out, fr_out, act_out
 
     wt_out = jnp.full((rc, n), -1, dtype=jnp.int32)
@@ -146,6 +174,36 @@ def frontier_chunk(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
     wt_last, fr_last, wt_out, fr_out, act_out = lax.fori_loop(
         0, rc, round_step, (wt_prev, fr_prev, wt_out, fr_out, act_out))
     return wt_out, fr_out, act_out, wt_last, fr_last
+
+
+@functools.partial(jax.jit, static_argnames=("n", "sm", "rcap"))
+def frontier_sweep(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
+                   wt_tab, fr_tab, wt_prev, fr_prev, t0, rho_min,
+                   pos2k=None, *, n, sm, rcap):
+    """Single-dispatch frontier: run rounds rho_min+t for t in [t0, rcap)
+    under a device while-loop until no chain has a candidate, writing
+    into the [rcap, n] tables (rows >= t0 are overwritten; rows < t0 are
+    the frozen warm-start prefix). Returns (wt_tab, fr_tab, t_end);
+    t_end == rcap with activity still pending means the caller must
+    re-run with a larger bucket."""
+    k_cap = chain_la.shape[1]
+    step = make_round_step(chain_la, chain_rbase, chain_len, la, fd, rbase,
+                           chain, n=n, sm=sm, pos2k=pos2k)
+
+    def cond(carry):
+        t, active, *_ = carry
+        return (t < rcap) & active
+
+    def body(carry):
+        t, _, wt_prev, fr_prev, wt_tab, fr_tab = carry
+        wt_row, fr, fr_c, any_cand = step(rho_min + t, wt_prev, fr_prev)
+        wt_tab = lax.dynamic_update_slice(wt_tab, wt_row[None], (t, 0))
+        fr_tab = lax.dynamic_update_slice(fr_tab, fr_c[None], (t, 0))
+        return t + 1, any_cand, wt_row, fr, wt_tab, fr_tab
+
+    t_end, _, _, _, wt_tab, fr_tab = lax.while_loop(
+        cond, body, (t0, jnp.bool_(True), wt_prev, fr_prev, wt_tab, fr_tab))
+    return wt_tab, fr_tab, t_end
 
 
 @functools.partial(jax.jit, static_argnames=("n",))

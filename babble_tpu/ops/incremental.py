@@ -153,13 +153,12 @@ class IncrementalEngine:
     """
 
     def __init__(self, n: int, root_round=None, *, capacity: int = 256,
-                 block: int = 256, k_capacity: int = 64, rc: int = 64):
+                 block: int = 256, k_capacity: int = 64):
         if n < 1:
             raise ValueError("need at least one participant")
         self.n = n
         self.sm = 2 * n // 3 + 1
         self.block = block
-        self.rc = rc
         self.root_round = (
             np.full(n, -1, np.int32) if root_round is None
             else np.asarray(root_round, np.int32).copy()
@@ -206,6 +205,12 @@ class IncrementalEngine:
 
         self._new_since_run: List[int] = []
         self._empty_delta_ok = False  # True when state is at a fixpoint
+
+        # Per-phase wall time (ns) of the last run(), mirroring the
+        # reference's phase logging around the consensus pipeline
+        # (node/core.go:278-296). Keys: coords, fd, frontier, rounds,
+        # fame, rr.
+        self.phase_ns: dict = {}
 
     # -- append ------------------------------------------------------------
 
@@ -297,6 +302,26 @@ class IncrementalEngine:
         if self.e == 0 or (self._empty_delta_ok and not self._new_since_run):
             return RunDelta(last_consensus_round=self.last_consensus_round)
         n, sm, e = self.n, self.sm, self.e
+        import os as _os
+        import time as _time
+
+        _t = _time.perf_counter_ns
+        _phase_start = _t()
+        self.phase_ns = {}
+        # Without the env flag, phases are NOT synced: the chip may sit
+        # behind a high-latency tunnel where every host sync costs a
+        # round-trip, so production runs keep the dispatch queue async
+        # and the timers only bracket host-visible boundaries.
+        _sync_timers = _os.environ.get("BABBLE_ENGINE_TIMERS") == "1"
+
+        def _mark(name, *sync):
+            nonlocal _phase_start
+            if _sync_timers:
+                for x in sync:
+                    jax.block_until_ready(x)
+            now = _t()
+            self.phase_ns[name] = now - _phase_start
+            _phase_start = now
 
         sp_d = jnp.asarray(self.self_parent)
         op_d = jnp.asarray(self.other_parent)
@@ -316,11 +341,17 @@ class IncrementalEngine:
         self._frozen_blocks = e // self.block
         la = self._la[: self.cap]
         rb = self._rb[: self.cap]
+        _mark("coords", la)
 
         # 2. First descendants (closed form, full recompute: old events'
-        # entries legitimately change when descendants arrive).
-        fd = kernels.compute_first_descendants(
-            la, cr_d, idx_d, chain_d, chain_len_d, n=n)
+        # entries legitimately change when descendants arrive). The
+        # pos2k cube doubles as the frontier's per-round strongly-see
+        # lookup table when it fits ([n^3] working set in the sweep).
+        cube = kernels.first_descendant_cube(la, chain_d, chain_len_d, n=n)
+        fd = kernels.fd_from_cube(cube, cr_d, idx_d, n=n)
+        pos2k = cube if n * n * n <= (1 << 24) else None
+        del cube  # at large n the [n, n, kcap] table is HBM-heavy
+        _mark("fd", fd)
 
         # 3. Witness frontier, warm-started at the first growable row.
         rel_rows = len(self._fr_table)
@@ -339,21 +370,26 @@ class IncrementalEngine:
         else:
             wt_prev = jnp.full((n,), -1, jnp.int32)
             fr_prev = jnp.zeros((n,), jnp.int32)
-        wt_rows = [self._wt_table[:t0]]
-        fr_rows = [self._fr_table[:t0]]
-        rho0 = self.rho_min + t0
+        # Single-dispatch device sweep: one host sync (t_end) per run,
+        # instead of one per rc-round chunk — the tunnel round-trip is
+        # the cost that matters, not the round count.
+        rcap = _pow2(rel_rows + 8, 16)
         while True:
-            wt_o, fr_o, act, wt_prev, fr_prev = frontier.frontier_chunk(
+            wt_tab = np.full((rcap, n), -1, np.int32)
+            fr_tab = np.full((rcap, n), self.kcap, np.int32)
+            wt_tab[:t0] = self._wt_table[:t0]
+            fr_tab[:t0] = self._fr_table[:t0]
+            wt_tab_d, fr_tab_d, t_end = frontier.frontier_sweep(
                 chain_la, chain_rbase, chain_len_d, la, fd, rb, chain_d,
-                wt_prev, fr_prev, jnp.int32(rho0), n=n, sm=sm, rc=self.rc)
-            act_np = np.asarray(act)
-            wt_rows.append(np.asarray(wt_o))
-            fr_rows.append(np.asarray(fr_o))
-            if not bool(act_np[-1]):
+                jnp.asarray(wt_tab), jnp.asarray(fr_tab), wt_prev, fr_prev,
+                jnp.int32(t0), jnp.int32(self.rho_min), pos2k, n=n, sm=sm,
+                rcap=rcap)
+            t_end = int(t_end)
+            if t_end < rcap:
                 break
-            rho0 += self.rc
-        fr_all = np.concatenate(fr_rows, axis=0)
-        wt_all = np.concatenate(wt_rows, axis=0)
+            rcap *= 2
+        fr_all = np.asarray(fr_tab_d)[:t_end]
+        wt_all = np.asarray(wt_tab_d)[:t_end]
         active = (fr_all < self.chain_len[None, :]).any(axis=1)
         n_rows = int(np.nonzero(active)[0][-1]) + 1 if active.any() else 0
         self._fr_table = fr_all[:n_rows]
@@ -367,6 +403,7 @@ class IncrementalEngine:
             grown = np.zeros((r_total, n), np.int32)
             grown[: self.famous.shape[0]] = self.famous
             self.famous = grown
+        _mark("frontier")
 
         delta = RunDelta()
 
@@ -388,6 +425,8 @@ class IncrementalEngine:
             if rnd not in self._queued_rounds:
                 self._queued_rounds.add(rnd)
                 bisect.insort(self.undecided_rounds, rnd)
+
+        _mark("rounds")
 
         # 5. Fame over the window [rx0, r_total).
         if self.undecided_rounds and self.undecided_rounds[0] < r_total:
@@ -423,6 +462,7 @@ class IncrementalEngine:
                         delta.last_commited_round_events = int(
                             (self.rounds[:e] == rho - 1).sum())
         delta.last_consensus_round = self.last_consensus_round
+        _mark("fame")
 
         # 6. Round received over the window [i0, r_total).
         first_undec = (
@@ -479,6 +519,7 @@ class IncrementalEngine:
                     self.cts_ns[i] = ns
                 delta.new_received.append((int(i), rr_i, ns))
 
+        _mark("rr")
         self._new_since_run = []
         self._empty_delta_ok = True
         return delta

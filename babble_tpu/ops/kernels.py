@@ -61,22 +61,17 @@ def compute_last_ancestors(self_parent, other_parent, creator, index, levels, *,
 
 
 @functools.partial(jax.jit, static_argnames=("n",))
-def compute_first_descendants(la, creator, index, chain, chain_len, *, n):
-    """first_desc[a, c] = index of the earliest event by creator c that
-    descends from a, INT32_MAX if none — reference
-    hashgraph.go:490-530.
+def first_descendant_cube(la, chain, chain_len, *, n):
+    """pos2k[c, i, t] = first position k on creator c's chain whose
+    event descends from chain i's position t (INT32_MAX when no such
+    position) — the closed form of the reference's first-descendant
+    chain walk (hashgraph.go:490-530): within chain c,
+    last_anc[chain[c, k], i] is monotone nondecreasing in k, so the
+    answer is one searchsorted per (c, i) column.
 
-    Closed form instead of the reference's self-parent chain walk:
-    within creator c's chain, last_anc[chain[c,k], i] is monotone
-    nondecreasing in k (children take the elementwise max over
-    parents), so the earliest descendant of a (creator ca, index ia) is
-    the first k with chain_la[c, k, ca] >= ia — one searchsorted per
-    (creator pair, target index).
-
-    la: [E, n]; creator/index: [E+1] padded; chain: [n, K]; returns
-    fd[E, n].
-    """
-    e = la.shape[0]
+    The cube is the shared primitive: per-event fd gathers from it
+    (fd_from_cube) and the round-frontier sweep turns its per-round
+    strongly-see searches into gathers (ops/frontier.py)."""
     k = chain.shape[1]
     chain_valid = chain >= 0
     # [n, K, n]; pad slots sort to the top so searchsorted lands on them
@@ -86,19 +81,51 @@ def compute_first_descendants(la, creator, index, chain, chain_len, *, n):
         la[jnp.where(chain_valid, chain, 0)],
         INT32_MAX,
     )
-    # ranks[c, i, t] = first k with chain_la[c, k, i] >= t, for every
-    # possible target index t in [0, K).
-    cols = jnp.transpose(chain_la, (0, 2, 1))  # [n(c), n(i), K]
-    targets = jnp.arange(k, dtype=jnp.int32)
-    ranks = jax.vmap(jax.vmap(lambda col: jnp.searchsorted(col, targets)))(cols)
-    ranks = ranks.astype(jnp.int32)  # [n(c), n(i), K]
-    fdv = jnp.where(ranks < chain_len[:, None, None], ranks, INT32_MAX)
-    # Scatter back per event: fd[chain[i, t], c] = fdv[c, i, t].
-    fd = jnp.full((e + 1, n), INT32_MAX, dtype=jnp.int32)
-    rows = jnp.broadcast_to(jnp.where(chain_valid, chain, e)[None, :, :], fdv.shape)
-    cidx = jnp.broadcast_to(jnp.arange(n)[:, None, None], fdv.shape)
-    fd = fd.at[rows, cidx].set(fdv)
-    return fd[:e]
+    # ranks[c, i, t] = #{k : chain_la[c, k, i] < t} — the searchsorted
+    # closed form, computed as dense chunked compare-and-count (VPU
+    # work) instead of vmapped binary search (gather-bound on TPU).
+    # Chunked over targets to bound the [n, K, n, tc] compare cube; the
+    # rank table is padded to a chunk multiple so arbitrary K (the
+    # one-shot path's K = max index + 1) keeps full-width chunks.
+    tc = min(max((1 << 27) // max(n * n * k, 1), 1), k)
+    nchunks = (k + tc - 1) // tc
+    k_pad = nchunks * tc
+
+    def tchunk(g, acc):
+        t0 = g * tc
+        ts = t0 + jnp.arange(tc, dtype=jnp.int32)
+        cnt = (chain_la[:, :, :, None] < ts[None, None, None, :]).sum(
+            1, dtype=jnp.int32)  # [n(c), n(i), tc]
+        return lax.dynamic_update_slice(acc, cnt, (0, 0, t0))
+
+    ranks = lax.fori_loop(
+        0, nchunks, tchunk, jnp.zeros((n, n, k_pad), dtype=jnp.int32))[
+        :, :, :k]
+    return jnp.where(ranks < chain_len[:, None, None], ranks, INT32_MAX)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def fd_from_cube(cube, creator, index, *, n):
+    """fd[a, c] from the pos2k cube: event a = chain[creator_a,
+    index_a], so fd[a, c] = cube[c, creator_a, index_a] — a gather
+    (a scatter would serialize on TPU). Pad rows (index < 0) stay at
+    INT32_MAX."""
+    e = creator.shape[0] - 1
+    k = cube.shape[2]
+    ca = creator[:e]
+    ia = jnp.clip(index[:e], 0, k - 1)
+    fd = cube[:, ca, ia].T  # [E, n]
+    return jnp.where((index[:e] >= 0)[:, None], fd, INT32_MAX)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def compute_first_descendants(la, creator, index, chain, chain_len, *, n):
+    """first_desc[a, c] = index of the earliest event by creator c that
+    descends from a, INT32_MAX if none — reference
+    hashgraph.go:490-530. la: [E, n]; creator/index: [E+1] padded;
+    chain: [n, K]; returns fd[E, n]."""
+    cube = first_descendant_cube(la, chain, chain_len, n=n)
+    return fd_from_cube(cube, creator, index, n=n)
 
 
 @functools.partial(jax.jit, static_argnames=("n", "sm", "r"))

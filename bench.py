@@ -372,6 +372,46 @@ def child():
             payload["vs_baseline"] = round(payload["value"] / host_eps, 1)
         _emit(payload)
 
+    # -- stage 2b: sustained incremental ingest ---------------------------
+    # The live-node metric: events arrive in sync-sized batches and each
+    # batch re-runs consensus over the undecided tip (ops/incremental.py)
+    # — the counterpart of the reference's per-sync RunConsensus
+    # (node/core.go:277-296) rather than a one-shot full-DAG recompute.
+    if _budget_left() > 120:
+        from babble_tpu.ops.incremental import IncrementalEngine
+
+        n, e_sus, bs = 64, 50_000, 4096
+        log(f"stage sustained: n={n} e={e_sus} batch={bs}")
+        dag_s, _ = synthetic_dag(n, e_sus, seed=3)
+        eng = IncrementalEngine(
+            n, capacity=65536, block=512, k_capacity=1024)
+        import numpy as _np
+
+        t0 = time.perf_counter()
+        per_batch = []
+        k = 0
+        while k < e_sus:
+            hi = min(k + bs, e_sus)
+            eng.append_batch(
+                dag_s.self_parent[k:hi], dag_s.other_parent[k:hi],
+                dag_s.creator[k:hi], dag_s.index[k:hi], dag_s.coin[k:hi],
+                _np.arange(k, hi))
+            tb = time.perf_counter()
+            eng.run()
+            per_batch.append(time.perf_counter() - tb)
+            k = hi
+        total = time.perf_counter() - t0
+        if e_sus % bs:  # final partial batch would skew the per-batch rate
+            per_batch = per_batch[:-1]
+        steady = float(_np.median(per_batch[len(per_batch) // 2:]))
+        log(f"  sustained: {total:.1f}s total ({e_sus / total:,.0f} ev/s), "
+            f"steady {bs / steady:,.0f} ev/s, "
+            f"{int((eng.rr[:e_sus] >= 0).sum())} consensus")
+        payload["sustained_events_per_s"] = round(e_sus / total, 1)
+        payload["sustained_steady_events_per_s"] = round(bs / steady, 1)
+        payload["sustained_batch"] = bs
+        _emit(payload)
+
     # -- stage 3: north star n=1024 e=100k --------------------------------
     # Skipped on the CPU fallback: at this size a host CPU cannot finish
     # inside any reasonable budget, and the number is only meaningful on
