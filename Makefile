@@ -1,0 +1,32 @@
+# Build/CI entry points — reference makefile:24-25 (`make test`) plus
+# the bench and demo-testnet drivers.
+PY ?= python
+
+.PHONY: test test-fast bench demo conf run bombard watch stop
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q -m "not slow"
+
+bench:
+	$(PY) bench.py
+
+demo:
+	demo/scripts/demo.sh
+
+conf:
+	demo/scripts/conf.sh
+
+run:
+	demo/scripts/run-testnet.sh
+
+bombard:
+	demo/scripts/bombard.sh
+
+watch:
+	demo/scripts/watch.sh
+
+stop:
+	demo/scripts/stop.sh

@@ -67,6 +67,18 @@ class FileStore:
         self._db.execute("PRAGMA synchronous=NORMAL")
         self._init_schema()
 
+        if exists and create:
+            # A populated database must be reopened with load(): the
+            # create path would overwrite persisted roots with fresh
+            # base roots while leaving the events table — an empty
+            # cache over a non-empty log whose last_from/known disagree
+            # with disk until a bootstrap replay.
+            row = self._db.execute("SELECT COUNT(*) FROM events").fetchone()
+            if row and row[0]:
+                self._db.close()
+                raise ValueError(
+                    f"{path} already contains events; use FileStore.load()"
+                )
         if exists and not create:
             participants = self._db_participants()
         elif participants:

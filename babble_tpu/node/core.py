@@ -2,10 +2,14 @@
 tracks the head/sequence, computes sync diffs, and drives the consensus
 pipeline.
 
-Reference node/core.go:15-369."""
+Reference node/core.go:15-369. Per-phase wall-clock (ns) around
+diff/sync/run_consensus mirrors the reference's phase logging
+(node/node.go:238-240,397-402; node/core.go:277-296) and is surfaced in
+node stats / the HTTP service."""
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, List, Optional
 
 from .. import crypto
@@ -44,6 +48,16 @@ class Core:
         self.head = ""
         self.seq = -1
         self.transaction_pool: List[bytes] = []
+        # phase -> (last ns, total ns, calls); written only under the
+        # node's core lock, like every other Core mutation.
+        self.phase_ns: Dict[str, List[int]] = {}
+
+    def _timed(self, phase: str, t0: int) -> None:
+        dt = time.perf_counter_ns() - t0
+        ent = self.phase_ns.setdefault(phase, [0, 0, 0])
+        ent[0] = dt
+        ent[1] += dt
+        ent[2] += 1
 
     def pub_key(self) -> bytes:
         if self._pub_key is None:
@@ -103,17 +117,20 @@ class Core:
     def diff(self, known: Dict[int, int]) -> List[Event]:
         """Events we know that `known` doesn't, in topological order —
         reference node/core.go:166-188."""
+        t0 = time.perf_counter_ns()
         unknown: List[Event] = []
         for pid, ct in known.items():
             pk = self.reverse_participants[pid]
             for ehex in self.hg.store.participant_events(pk, ct):
                 unknown.append(self.hg.store.get_event(ehex))
         unknown.sort(key=lambda e: e.topological_index)
+        self._timed("diff", t0)
         return unknown
 
     def sync(self, unknown: List[WireEvent]) -> None:
         """Insert synced events, then wrap the tx pool and the other
         party's head in a new self-event — reference node/core.go:190-230."""
+        t0 = time.perf_counter_ns()
         other_head = ""
         for k, we in enumerate(unknown):
             ev = self.hg.read_wire_info(we)
@@ -130,6 +147,7 @@ class Core:
             )
             self.sign_and_insert_self_event(new_head)
             self.transaction_pool = []
+        self._timed("sync", t0)
 
     def add_self_event(self) -> None:
         """Wrap a non-empty tx pool in a new self-event — reference
@@ -152,7 +170,18 @@ class Core:
         return [e.to_wire() for e in events]
 
     def run_consensus(self) -> None:
+        t0 = time.perf_counter_ns()
         self.hg.run_consensus()
+        self._timed("run_consensus", t0)
+        # Device-engine sub-phases (coords/fd/frontier/fame/rr) when the
+        # batched pipeline is active.
+        engine = getattr(self.hg, "engine", None)
+        if engine is not None and getattr(engine, "phase_ns", None):
+            for ph, ns in engine.phase_ns.items():
+                ent = self.phase_ns.setdefault(f"engine_{ph}", [0, 0, 0])
+                ent[0] = ns
+                ent[1] += ns
+                ent[2] += 1
 
     def add_transactions(self, txs: List[bytes]) -> None:
         self.transaction_pool.extend(txs)

@@ -85,6 +85,7 @@ class Node:
         self.start_time = time.monotonic()
         self.sync_requests = 0
         self.sync_errors = 0
+        self._stats_lock = threading.Lock()  # counters hit by gossip + RPC threads
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -203,6 +204,8 @@ class Node:
             return True
 
     def _gossip(self, peer_addr: str) -> None:
+        if self._shutdown.is_set():
+            return
         try:
             sync_limit, other_known = self._pull(peer_addr)
         except TransportError as exc:
@@ -227,20 +230,26 @@ class Node:
         self.state.set_starting(False)
 
     def _pull(self, peer_addr: str):
+        if self._shutdown.is_set():
+            raise TransportError("node is shutting down")
         with self.core_lock:
             known = self.core.known()
 
-        self.sync_requests += 1
+        with self._stats_lock:
+            self.sync_requests += 1
         try:
             resp = self.trans.sync(peer_addr, SyncRequest(self.id, known))
         except Exception:
-            self.sync_errors += 1
+            with self._stats_lock:
+                self.sync_errors += 1
             raise
 
         if resp.sync_limit:
             return True, None
 
         with self.core_lock:
+            if self._shutdown.is_set():
+                raise TransportError("node is shutting down")
             self._sync(resp.events)
         return False, resp.known
 
@@ -251,11 +260,13 @@ class Node:
             diff = self.core.diff(known)
             wire_events = self.core.to_wire(diff)
 
-        self.sync_requests += 1
+        with self._stats_lock:
+            self.sync_requests += 1
         try:
             self.trans.eager_sync(peer_addr, EagerSyncRequest(self.id, wire_events))
         except Exception:
-            self.sync_errors += 1
+            with self._stats_lock:
+                self.sync_errors += 1
             raise
 
     def _sync(self, events) -> None:
@@ -355,6 +366,14 @@ class Node:
             "round_events": str(self.core.get_last_commited_round_events_count()),
             "id": str(self.id),
             "state": str(self.state.get_state()),
+        } | {
+            # Per-phase wall times (reference logs ns around every
+            # Diff/Sync/RunConsensus call, node/core.go:277-296): last
+            # call and lifetime average per phase. list() snapshots the
+            # dict against concurrent first-phase inserts by gossip/RPC
+            # threads (the HTTP service thread calls this unlocked).
+            f"time_{phase}_ns": f"{ent[0]};avg={ent[1] // max(ent[2], 1)}"
+            for phase, ent in list(self.core.phase_ns.items())
         }
 
     def sync_rate(self) -> float:

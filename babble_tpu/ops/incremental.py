@@ -300,6 +300,9 @@ class IncrementalEngine:
 
     def run(self) -> RunDelta:
         if self.e == 0 or (self._empty_delta_ok and not self._new_since_run):
+            # No-op runs must not leave stale phase timings for callers
+            # that aggregate them (node/core.py).
+            self.phase_ns = {}
             return RunDelta(last_consensus_round=self.last_consensus_round)
         n, sm, e = self.n, self.sm, self.e
         import os as _os

@@ -80,6 +80,29 @@ def _round_bucket(max_round: int, bound: int) -> int:
     return min(r, bound)
 
 
+def tight_round_bucket(rounds, bound: int) -> int:
+    """The fame/round-received round capacity from observed rounds (one
+    host round-trip): votes are O(r^2), so the observed max round — not
+    the depth-derived static bound — sets the real cost. Shared by the
+    one-shot engines, the factored view sim, and the sharded pipeline."""
+    import numpy as np
+
+    arr = np.asarray(rounds)
+    max_round = int(arr.max()) if arr.size else 0
+    return _round_bucket(max_round, bound)
+
+
+def pad_famous(famous_small, bound: int, n: int):
+    """Restore the [bound, n] famous-table contract: rounds beyond the
+    tight bucket have no witnesses and stay UNDEFINED (== 0, which is
+    what the zero padding encodes)."""
+    import numpy as np
+
+    famous = np.zeros((bound, n), dtype=np.int32)
+    famous[: np.asarray(famous_small).shape[0]] = np.asarray(famous_small)
+    return famous
+
+
 def run_pipeline_wavefront(dag):
     """The original depth-sequential driver (one dispatch step per DAG
     level) — kept as a second oracle for kernel cross-validation."""
@@ -90,17 +113,12 @@ def run_pipeline_wavefront(dag):
         dag.self_parent, dag.other_parent, dag.creator, dag.index, dag.levels,
         dag.chain, dag.chain_len, dag.root_round, n=n, sm=sm, r=r_bound,
     )
-    max_round = int(np.asarray(rounds).max()) if dag.e else 0
-    r_small = _round_bucket(max_round, r_bound)
+    r_small = tight_round_bucket(rounds if dag.e else np.zeros(0), r_bound)
     famous_small, rr, cts = _fame_and_order(
         wt[:r_small], la, fd, rounds, dag.creator, dag.index, dag.coin,
         dag.chain_rank, n=n, sm=sm, r=r_small,
     )
-    # Restore the [max_rounds, n] shape contract: rounds beyond r_small
-    # have no witnesses (wt rows are -1) and stay UNDEFINED.
-    famous = np.zeros((r_bound, n), dtype=np.int32)
-    famous[:r_small] = np.asarray(famous_small)
-    return rounds, wit, wt, famous, rr, cts
+    return rounds, wit, wt, pad_famous(famous_small, r_bound, n), rr, cts
 
 
 def _default_engine() -> str:
@@ -155,6 +173,4 @@ def run_pipeline(dag, block: int = 512, engine: str = "auto"):
     )
     wt = np.full((r_bound, n), -1, dtype=np.int32)
     wt[: wt_np.shape[0]] = wt_np
-    famous = np.zeros((r_bound, n), dtype=np.int32)
-    famous[:r_small] = np.asarray(famous_small)
-    return rounds, wit, wt, famous, rr, cts
+    return rounds, wit, wt, pad_famous(famous_small, r_bound, n), rr, cts

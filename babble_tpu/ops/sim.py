@@ -17,7 +17,7 @@ witness tables.
 from __future__ import annotations
 
 import random
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -25,7 +25,8 @@ import numpy as np
 from ..gojson import Timestamp
 from .. import crypto
 from ..hashgraph.event import Event
-from .dag import DagTensors, build_dag
+from . import kernels
+from .dag import DagTensors, _assemble, build_dag
 from .pipeline import consensus_pipeline
 
 
@@ -88,6 +89,187 @@ class GossipSim:
         return build_dag(self.events, self.participants)
 
 
+def gossip_schedule(
+    n: int,
+    steps: int,
+    *,
+    selector: str = "uniform",
+    alpha: float = 1.5,
+    silent: Optional[np.ndarray] = None,
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Peer-selection schedule tensors (creators[steps], targets[steps]).
+
+    selector="uniform" reproduces the reference RandomPeerSelector:
+    uniform over peers excluding self and the last-synced peer
+    (node/peer_selector.go:38-46). selector="powerlaw" weights target
+    choice by rank**-alpha — the skewed-topology axis of the batched
+    simulation plan (SURVEY §7 step 5). `silent` [n] bool marks peers
+    that never initiate or answer a sync (the missing/silent-byzantine
+    node of node_test.go:409-420): they are excluded from both sides of
+    the schedule, so their initial events stay unknown to the rest of
+    the network."""
+    rng = np.random.default_rng(seed)
+    silent = np.zeros(n, bool) if silent is None else np.asarray(silent, bool)
+    active = np.nonzero(~silent)[0]
+    if len(active) < 2:
+        raise ValueError("need at least two non-silent peers")
+    if selector == "powerlaw":
+        w = (1.0 + np.arange(n, dtype=np.float64)) ** -alpha
+    elif selector == "uniform":
+        w = np.ones(n, dtype=np.float64)
+    else:
+        raise ValueError(f"unknown selector {selector!r}")
+    w[silent] = 0.0
+
+    creators = rng.choice(active, size=steps)
+    targets = np.zeros(steps, dtype=np.int64)
+    last = np.full(n, -1, dtype=np.int64)
+    for t in range(steps):
+        c = int(creators[t])
+        wt = w.copy()
+        wt[c] = 0.0
+        if last[c] >= 0 and wt.sum() - wt[last[c]] > 0:
+            wt[last[c]] = 0.0  # exclude the previously-synced peer
+        wt /= wt.sum()
+        j = int(rng.choice(n, p=wt))
+        targets[t] = j
+        last[c] = j
+    return creators.astype(np.int32), targets.astype(np.int32)
+
+
+def simulate_views(
+    n: int,
+    steps: int,
+    *,
+    selector: str = "uniform",
+    alpha: float = 1.5,
+    silent: Optional[np.ndarray] = None,
+    seed: int = 0,
+    snapshots: Optional[Sequence[int]] = None,
+) -> Tuple[DagTensors, np.ndarray, np.ndarray]:
+    """Array-native batched gossip: run a schedule, producing the global
+    DAG tensors, per-peer ancestry-closed view masks, and the synthetic
+    signature ranks for the final sort. No crypto, no Event objects —
+    the at-scale counterpart of GossipSim (which carries real signed
+    events for parity tests).
+
+    `snapshots` (step counts, ascending; default [steps]) captures every
+    peer's view at each checkpoint, returning [len(snapshots)*n, E]
+    masks — temporal views are ancestry-closed too, so the consistency
+    oracle also checks that a peer's earlier order is a prefix of its
+    later one (the monotonicity the reference gets from append-only
+    ConsensusEvents, hashgraph.go:826-838)."""
+    silent = np.zeros(n, bool) if silent is None else np.asarray(silent, bool)
+    creators_s, targets_s = gossip_schedule(
+        n, steps, selector=selector, alpha=alpha, silent=silent, seed=seed)
+    rng = np.random.default_rng(seed + 1)
+
+    e = n + steps
+    self_parent = np.full(e + 1, -1, np.int32)
+    other_parent = np.full(e + 1, -1, np.int32)
+    creator = np.zeros(e + 1, np.int32)
+    index = np.zeros(e + 1, np.int32)
+    heads = np.full(n, -1, np.int64)
+    seqs = np.full(n, -1, np.int64)
+    know = np.zeros((n, e), dtype=bool)
+
+    for i in range(n):  # initial events (reference core.Init)
+        creator[i] = i
+        seqs[i] = 0
+        heads[i] = i
+        know[i, i] = True
+
+    if snapshots is None:
+        snapshots = [steps]
+    snap_masks: List[np.ndarray] = []
+    snap_iter = iter(sorted(snapshots))
+    next_snap = next(snap_iter)
+    for t in range(steps):
+        while next_snap == t:
+            snap_masks.append(know.copy())
+            next_snap = next(snap_iter, None)
+        eid = n + t
+        c, j = int(creators_s[t]), int(targets_s[t])
+        know[c] |= know[j]  # pull: full ancestry closure transfers
+        self_parent[eid] = heads[c]
+        other_parent[eid] = heads[j]
+        creator[eid] = c
+        seqs[c] += 1
+        index[eid] = seqs[c]
+        heads[c] = eid
+        know[c, eid] = True
+    while next_snap is not None:
+        snap_masks.append(know.copy())
+        next_snap = next(snap_iter, None)
+    masks = np.concatenate(snap_masks, axis=0)
+
+    coin = np.zeros(e + 1, np.int8)
+    coin[:e] = rng.integers(0, 2, size=e, dtype=np.int8)
+    ts_rank = np.zeros(e + 1, np.int32)
+    ts_rank[:e] = np.arange(e, dtype=np.int32)
+    ts_values = np.arange(e, dtype=np.int64)
+    root_round = np.full(n, -1, np.int32)
+    s_rank = rng.integers(0, 2**62, size=e, dtype=np.int64)
+
+    dag = _assemble(
+        n, e, self_parent, other_parent, creator, index, coin, ts_rank,
+        ts_values, root_round, hexes=[], hex_to_id={}, events=[])
+    return dag, masks, s_rank
+
+
+def consensus_views_factored(dag: DagTensors, masks: np.ndarray):
+    """Per-view consensus with shared coordinates: last-ancestors and
+    first-descendants are exact for every ancestry-closed view (see
+    kernels.compute_rounds), so they are computed ONCE on the full DAG
+    and only the witness-table-dependent stages (rounds, fame, round
+    received) are vmapped over the view masks. This is what makes
+    V=n-peer simulation affordable: the O(E) coordinate sweeps do not
+    multiply by V.
+
+    masks: [V, E] bool. Returns per-view (rounds, witness, wt, famous,
+    rr, cts) with a leading V axis."""
+    v, e = masks.shape
+    assert e == dag.e
+    n, sm, r = dag.n, dag.super_majority, dag.max_rounds
+    padded = np.zeros((v, e + 1), dtype=bool)
+    padded[:, :e] = masks
+
+    la = kernels.compute_last_ancestors(
+        dag.self_parent, dag.other_parent, dag.creator, dag.index,
+        dag.levels, n=n)
+    fd = kernels.compute_first_descendants(
+        la, dag.creator, dag.index, dag.chain, dag.chain_len, n=n)
+
+    def rounds_one(mask):
+        return kernels.compute_rounds(
+            dag.self_parent, dag.other_parent, dag.creator, dag.index,
+            la, fd, dag.levels, dag.root_round, mask, n=n, sm=sm, r=r)
+
+    rounds_v, wit_v, wt_v = jax.vmap(rounds_one)(padded)
+
+    # Fame/round-received at a tight round bucket — same trick as
+    # pipeline.run_pipeline.
+    from .pipeline import pad_famous, tight_round_bucket
+
+    r_small = tight_round_bucket(rounds_v if e else np.zeros(0), r)
+    wt_small = jax.numpy.asarray(np.asarray(wt_v)[:, :r_small])
+
+    def fame_rr_one(wt_s, rounds, mask):
+        famous = kernels.decide_fame(
+            wt_s, la, fd, dag.index, dag.coin, n=n, sm=sm, r=r_small)
+        rr, cts = kernels.decide_round_received(
+            rounds, wt_s, famous, la, fd, dag.creator, dag.index,
+            dag.chain_rank, mask, n=n, r=r_small)
+        return famous, rr, cts
+
+    famous_s, rr_v, cts_v = jax.vmap(fame_rr_one)(
+        wt_small, rounds_v, padded)
+    famous_v = np.stack(
+        [pad_famous(f, r, n) for f in np.asarray(famous_s)])
+    return rounds_v, wit_v, wt_v, famous_v, rr_v, cts_v
+
+
 def consensus_views(dag: DagTensors, masks: np.ndarray):
     """Run the masked consensus pipeline for V views in one vmap.
 
@@ -121,10 +303,12 @@ def consensus_views(dag: DagTensors, masks: np.ndarray):
 
 
 def view_order(dag: DagTensors, rr: np.ndarray, cts: np.ndarray,
-               s_ints: Optional[List[int]] = None) -> List[int]:
+               s_ints: Optional[Sequence[int]] = None) -> List[int]:
     """Consensus total order of one view as event ids: (roundReceived,
     consensusTimestamp, raw S) — the ConsensusSorter (reference
-    consensus_sorter.go:21-52)."""
+    consensus_sorter.go:21-52). `s_ints` stands in for the raw big-int
+    signature S; defaults to the real signatures when the DAG carries
+    Event objects (synthetic DAGs pass their s_rank array)."""
     if s_ints is None:
         s_ints = [int(ev.s) for ev in dag.events]
     ids = [i for i in range(dag.e) if rr[i] >= 0]
@@ -132,14 +316,17 @@ def view_order(dag: DagTensors, rr: np.ndarray, cts: np.ndarray,
     return ids
 
 
-def check_view_consistency(dag: DagTensors, rr_v: np.ndarray,
-                           cts_v: np.ndarray) -> List[List[int]]:
+def check_view_consistency(
+    dag: DagTensors, rr_v: np.ndarray, cts_v: np.ndarray,
+    s_ints: Optional[Sequence[int]] = None,
+) -> List[List[int]]:
     """The checkGossip oracle over all views: every pair of views'
     consensus orders must be prefix-compatible. Prefix-compatibility
     with the longest order implies it pairwise, so each view is checked
     against the longest only. Returns the per-view orders; raises
     AssertionError on divergence."""
-    s_ints = [int(ev.s) for ev in dag.events] if dag.events else None
+    if s_ints is None and dag.events:
+        s_ints = [int(ev.s) for ev in dag.events]
     orders = [
         view_order(dag, rr_v[v], cts_v[v], s_ints) for v in range(rr_v.shape[0])
     ]

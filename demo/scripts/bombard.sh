@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Flood Babble.SubmitTx at a node's JSON-RPC app proxy — reference
+# demo/scripts/bombard.sh (raw JSON over nc), speaking the same
+# Go net/rpc/jsonrpc framing our SocketAppProxy serves.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+BASE_PORT="${BASE_PORT:-22000}" COUNT="${COUNT:-200}" TARGET="${TARGET:-0}"
+python - "$((BASE_PORT + TARGET * 10 + 1))" "$COUNT" <<'PY'
+import base64, json, socket, sys, time
+port, count = int(sys.argv[1]), int(sys.argv[2])
+s = socket.create_connection(("127.0.0.1", port), timeout=5)
+f = s.makefile("rw")
+for i in range(count):
+    tx = base64.b64encode(f"bombard tx {i}".encode()).decode()
+    f.write(json.dumps(
+        {"method": "Babble.SubmitTx", "params": [tx], "id": i}) + "\n")
+    f.flush()
+    json.loads(f.readline())
+    time.sleep(0.003)  # reference bombards every 3ms
+print(f"submitted {count} transactions to port {port}")
+PY

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Generate per-node datadirs + the shared peers.json — the counterpart
+# of reference demo/scripts/build-conf.sh (keygen per node, assemble
+# peers.json from the public keys).
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+NODES="${NODES:-4}" BASE_PORT="${BASE_PORT:-22000}" CONF="demo/conf"
+rm -rf "$CONF"; mkdir -p "$CONF/logs"
+python - "$NODES" "$BASE_PORT" "$CONF" <<'PY'
+import json, subprocess, sys
+n, base, conf = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+pubs = []
+for i in range(n):
+    out = subprocess.run(
+        [sys.executable, "-m", "babble_tpu.cli", "keygen",
+         "--datadir", f"{conf}/node{i}"],
+        check=True, capture_output=True, text=True).stdout
+    pubs.append(out.split("PublicKey: ")[1].split()[0])
+peers = [{"NetAddr": f"127.0.0.1:{base + i * 10}", "PubKeyHex": pubs[i]}
+         for i in range(n)]
+for i in range(n):
+    with open(f"{conf}/node{i}/peers.json", "w") as f:
+        json.dump(peers, f, indent=2)
+print(f"wrote {conf}/node{{0..{n-1}}}/ (peers.json + priv_key.pem)")
+PY

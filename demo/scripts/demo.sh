@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# conf + run + short bombard + one stats snapshot — reference
+# demo/scripts/demo.sh in one command.
+set -euo pipefail
+cd "$(dirname "$0")"
+NODES="${NODES:-4}" BASE_PORT="${BASE_PORT:-22000}"
+export NODES BASE_PORT
+./conf.sh
+./run-testnet.sh
+trap ./stop.sh EXIT
+sleep 3
+COUNT="${COUNT:-100}" ./bombard.sh
+sleep 2
+for i in $(seq 0 $((NODES - 1))); do
+  echo "--- node $i ---"
+  curl -fsS "http://127.0.0.1:$((BASE_PORT + 1000 + i))/Stats" && echo
+done

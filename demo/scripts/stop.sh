@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+set -uo pipefail
+cd "$(dirname "$0")/../.."
+CONF="demo/conf"
+[ -f "$CONF/pids" ] && xargs -r kill < "$CONF/pids" 2>/dev/null
+rm -f "$CONF/pids"
+echo "testnet stopped"

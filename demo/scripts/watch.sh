@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Poll every node's /Stats once per second — reference
+# docker/watcher/watch.sh.
+set -euo pipefail
+NODES="${NODES:-4}" BASE_PORT="${BASE_PORT:-22000}"
+while true; do
+  clear 2>/dev/null || true
+  for i in $(seq 0 $((NODES - 1))); do
+    echo "--- node $i ---"
+    curl -fsS "http://127.0.0.1:$((BASE_PORT + 1000 + i))/Stats" || echo "down"
+    echo
+  done
+  sleep 1
+done

@@ -152,11 +152,13 @@ def make_nodes(n, transport, engine="host"):
     return nodes
 
 
-def run_gossip(nodes, target_round, timeout=60.0):
+def run_gossip(nodes, target_round, timeout=60.0, shutdown=True):
     """Run all nodes and bombard them with transactions until every
     node reaches target_round — the reference's gossip/bombardAndWait
     driver (node_test.go:507-545,601-617). Continuous submission
-    matters: nodes go quiescent by design when nothing is pending."""
+    matters: nodes go quiescent by design when nothing is pending.
+    shutdown=False leaves the testnet running (reference gossip()'s
+    shutdown flag, node_test.go:507)."""
     for node in nodes:
         node.run_async(gossip=True)
     submitted = []
@@ -178,8 +180,9 @@ def run_gossip(nodes, target_round, timeout=60.0):
         rounds = [n.core.get_last_consensus_round_index() for n in nodes]
         raise AssertionError(f"timeout: consensus rounds {rounds} < {target_round}")
     finally:
-        for node in nodes:
-            node.shutdown()
+        if shutdown:
+            for node in nodes:
+                node.shutdown()
 
 
 def check_gossip(nodes):
@@ -238,14 +241,19 @@ def test_stats():
     nodes = make_nodes(4, "inmem")
     run_gossip(nodes, target_round=3)
     stats = nodes[0].get_stats()
-    assert set(stats) == {
+    base = {
         "last_consensus_round", "consensus_events", "consensus_transactions",
         "undetermined_events", "transaction_pool", "num_peers", "sync_rate",
         "events_per_second", "rounds_per_second", "round_events", "id", "state",
     }
+    assert base <= set(stats)
     assert int(stats["last_consensus_round"]) >= 3
     assert int(stats["num_peers"]) == 3
     assert float(stats["events_per_second"]) > 0
+    # per-phase ns timers (reference node/core.go:277-296 phase logging)
+    for phase in ("diff", "sync", "run_consensus"):
+        last, avg = stats[f"time_{phase}_ns"].split(";avg=")
+        assert int(last) > 0 and int(avg) > 0
 
 
 def test_committed_transactions_reach_proxy():
@@ -258,3 +266,68 @@ def test_committed_transactions_reach_proxy():
     for c in committed:
         for tx in c:
             assert tx in submitted
+
+
+def test_sync_limit():
+    """A SyncRequest whose known map trails by more than sync_limit gets
+    SyncLimit=true instead of a diff, and the requester passes through
+    CatchingUp (whose fast-forward is a reference-parity stub that drops
+    back to Babbling) — reference node_test.go:422-459."""
+    from babble_tpu.net.transport import SyncRequest
+    from babble_tpu.node.state import NodeState
+
+    nodes = make_nodes(2, "inmem")
+    try:
+        # node 1 serves RPCs but does not gossip; node 0 stays un-run so
+        # its state transitions can be observed synchronously.
+        nodes[1].run_async(gossip=False)
+        for k in range(8):  # node 1 builds a backlog beyond the limit
+            nodes[1].core.add_transactions([f"tx {k}".encode()])
+            nodes[1].core.add_self_event()
+
+        # Serve-side: an empty-known request gets SyncLimit=true and no
+        # events once the backlog exceeds the limit.
+        nodes[1].conf.sync_limit = 5
+        behind = {i: -1 for i in range(2)}
+        resp = nodes[0].trans.sync(
+            nodes[1].local_addr, SyncRequest(nodes[0].id, behind))
+        assert resp.sync_limit, "expected SyncLimit=true for a lagging peer"
+        assert not resp.events
+
+        # Request-side: a pull that hits the limit drives the node into
+        # CatchingUp; the run loop's fast-forward (reference-parity
+        # stub, node/node.go:432-441) drops back to Babbling.
+        nodes[0].conf.sync_limit = 5
+        nodes[0]._gossip(nodes[1].local_addr)
+        assert nodes[0].state.get_state() == NodeState.CATCHING_UP
+        nodes[0]._fast_forward()
+        assert nodes[0].state.get_state() == NodeState.BABBLING
+    finally:
+        for node in nodes:
+            node.shutdown()
+
+
+def test_shutdown():
+    """Shutting a node down closes its transport (peers' syncs fail) and
+    the second shutdown is idempotent — reference node_test.go:461-475."""
+    from babble_tpu.net.transport import SyncRequest
+    from babble_tpu.node.state import NodeState
+
+    nodes = make_nodes(2, "inmem")
+    try:
+        for node in nodes:
+            node.run_async(gossip=True)
+        time.sleep(0.2)
+        nodes[0].shutdown()
+        assert nodes[0].state.get_state() == NodeState.SHUTDOWN
+
+        with pytest.raises(Exception):
+            nodes[1].trans.sync(
+                nodes[0].local_addr, SyncRequest(nodes[1].id, {0: -1, 1: -1}))
+
+        nodes[1].shutdown()
+        assert nodes[1].state.get_state() == NodeState.SHUTDOWN
+        nodes[1].shutdown()  # idempotent
+    finally:
+        for node in nodes:
+            node.shutdown()

@@ -1,0 +1,34 @@
+"""Multi-chip parity: the sharded pipeline (ops/sharded.py) must match
+the single-device wavefront pipeline bit-for-bit on the 8-device
+virtual mesh — rounds, witnesses, witness table, fame, round received,
+and consensus timestamps (SURVEY §5 comms plan; the driver re-checks
+this via dryrun_multichip)."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from babble_tpu.ops.dag import synthetic_dag
+from babble_tpu.ops.pipeline import run_pipeline
+from babble_tpu.ops.sharded import sharded_pipeline
+
+
+@pytest.mark.parametrize("n,e", [(8, 400), (16, 1000)], ids=["n8", "n16"])
+def test_sharded_matches_single_device(n, e):
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest must provision the virtual mesh"
+    mesh = Mesh(np.array(devices[:8]), ("sp",))
+
+    dag, _ = synthetic_dag(n, e, seed=11)
+    ref = [np.asarray(x) for x in run_pipeline(dag, engine="wavefront")]
+    got = [np.asarray(x) for x in sharded_pipeline(dag, mesh)]
+
+    names = ["rounds", "witness", "witness_table", "famous",
+             "round_received", "cts"]
+    for name, a, b in zip(names, ref, got):
+        assert a.shape == b.shape, name
+        assert (a == b).all(), (
+            f"{name} mismatch: {np.argwhere(a != b)[:5]}")
