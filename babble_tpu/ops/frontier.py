@@ -43,7 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .kernels import INT32_MAX
+from .kernels import INT32_MAX, first_descendant_cube
 
 # Working-set bound for the per-round [chains, coords, witnesses]
 # searchsorted cube: chains are processed in chunks so each materialized
@@ -72,57 +72,53 @@ def build_chain_tables(la, rbase, chain, *, n):
 
 
 def make_round_step(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
-                    *, n, sm, pos2k=None):
+                    pos2k, *, n, sm):
     """One frontier round: step(rho, wt_prev, fr_prev) ->
     (wt_row, fr_unclamped, fr_clamped, any_candidate). Shared by the
     chunked host driver below and the single-dispatch while-loop sweep
     (used by ops/incremental.py).
 
-    With `pos2k` (the kernels.first_descendant_cube [c, i, t] table),
-    the per-round strongly-see searchsorted collapses to a gather:
+    `pos2k` (the kernels.first_descendant_cube [c, i, t] table) turns
+    the per-round strongly-see search into a gather:
     k_ci[c, i, w] = pos2k[c, i, fd[w, i]] — both sides are positions on
-    chain i, so the precomputed inverse lookup answers every round."""
+    chain i, so the precomputed inverse lookup answers every round.
+    (vmapped binary searches are both slow and, on some TPU runtimes,
+    kernel-fault-prone at n=1024; everything here is dense compares and
+    gathers, chunked over chains to bound the [cc, n, n] working set.
+    Known issue: on the tunneled axon runtime the composed step still
+    faults at n=1024 — the wavefront engine (pipeline.py) is the
+    validated path at that scale; parity on CPU/virtual meshes holds at
+    all sizes.)"""
     k_cap = chain_la.shape[1]
-    cols = jnp.transpose(chain_la, (0, 2, 1))  # [c, i, K] each sorted
     cc = n // _chain_chunks(n)
 
     def step(rho, wt_prev, fr_prev):
         # k1: first chain position whose propagated root contribution
-        # reaches rho (chain_rbase is monotone along the chain).
-        k1 = jax.vmap(lambda col: jnp.searchsorted(col, rho))(chain_rbase)
-        k1 = k1.astype(jnp.int32)
+        # reaches rho = #{k : chain_rbase[c, k] < rho} (monotone along
+        # the chain; pads are INT32_MAX and never count).
+        k1 = (chain_rbase < rho).sum(1, dtype=jnp.int32)
 
         # k2: first position strongly seeing >= sm of wt_prev.
         wt_valid = wt_prev >= 0
         fdw = fd[jnp.where(wt_valid, wt_prev, 0)]  # [w, i]
 
         # first_k_ss[c, w] = sm-th smallest over i of
-        # k_ci[c, i, w] = first k with chain_la[c, k, i] >= fd[w, i],
-        # computed in chain chunks to bound the [cc, n, n] cube.
-        if pos2k is not None:
-            t_idx = jnp.clip(fdw.T, 0, k_cap - 1)  # [i, w]
-            k_ci_full = jnp.take_along_axis(
-                pos2k, jnp.broadcast_to(t_idx[None], (n, n, n)), axis=2)
-            k_ci_full = jnp.where(
-                (fdw.T < INT32_MAX)[None], k_ci_full, INT32_MAX)
-            first_k_ss = jnp.sort(k_ci_full, axis=1)[:, sm - 1, :]
-        else:
-            targets = jnp.broadcast_to(fdw.T[None], (cc, n, n))
+        # k_ci[c, i, w] = first k with chain_la[c, k, i] >= fd[w, i].
+        t_idx = jnp.clip(fdw.T, 0, k_cap - 1)  # [i, w]
+        t_bc = jnp.broadcast_to(t_idx[None], (cc, n, n))
+        fdw_ok = (fdw.T < INT32_MAX)[None]
 
-            def chain_chunk(g, acc):
-                c0 = g * cc
-                cols_g = lax.dynamic_slice(cols, (c0, 0, 0), (cc, n, k_cap))
-                len_g = lax.dynamic_slice(chain_len, (c0,), (cc,))
-                k_ci = jax.vmap(  # over chains c
-                    jax.vmap(jnp.searchsorted, in_axes=(0, 0))  # over coords
-                )(cols_g, targets).astype(jnp.int32)
-                k_ci = jnp.where(k_ci < len_g[:, None, None], k_ci, INT32_MAX)
-                part = jnp.sort(k_ci, axis=1)[:, sm - 1, :]  # [cc, w]
-                return lax.dynamic_update_slice(acc, part, (c0, 0))
+        def chain_chunk(g, acc):
+            c0 = g * cc
+            p2k_g = lax.dynamic_slice(pos2k, (c0, 0, 0), (cc, n, k_cap))
+            k_ci = jnp.take_along_axis(p2k_g, t_bc, axis=2)
+            k_ci = jnp.where(fdw_ok, k_ci, INT32_MAX)
+            part = jnp.sort(k_ci, axis=1)[:, sm - 1, :]  # [cc, w]
+            return lax.dynamic_update_slice(acc, part, (c0, 0))
 
-            first_k_ss = lax.fori_loop(
-                0, n // cc, chain_chunk,
-                jnp.full((n, n), INT32_MAX, dtype=jnp.int32))
+        first_k_ss = lax.fori_loop(
+            0, n // cc, chain_chunk,
+            jnp.full((n, n), INT32_MAX, dtype=jnp.int32))
         first_k_ss = jnp.where(wt_valid[None, :], first_k_ss, INT32_MAX)
         # k2[c] = sm-th smallest over w (needs sm witnesses seen)
         k2 = jnp.sort(first_k_ss, axis=1)[:, sm - 1]
@@ -149,7 +145,7 @@ def make_round_step(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
 
 @functools.partial(jax.jit, static_argnames=("n", "sm", "rc"))
 def frontier_chunk(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
-                   wt_prev, fr_prev, rho0, *, n, sm, rc):
+                   pos2k, wt_prev, fr_prev, rho0, *, n, sm, rc):
     """Advance the witness frontier by `rc` rounds starting at rho0.
 
     wt_prev: [n] witness event ids of round rho0-1 (-1 none);
@@ -158,7 +154,7 @@ def frontier_chunk(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
     """
     k_cap = chain_la.shape[1]
     step = make_round_step(chain_la, chain_rbase, chain_len, la, fd, rbase,
-                           chain, n=n, sm=sm)
+                           chain, pos2k, n=n, sm=sm)
 
     def round_step(t, carry):
         wt_prev, fr_prev, wt_out, fr_out, act_out = carry
@@ -178,8 +174,8 @@ def frontier_chunk(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
 
 @functools.partial(jax.jit, static_argnames=("n", "sm", "rcap"))
 def frontier_sweep(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
-                   wt_tab, fr_tab, wt_prev, fr_prev, t0, rho_min,
-                   pos2k=None, *, n, sm, rcap):
+                   pos2k, wt_tab, fr_tab, wt_prev, fr_prev, t0, rho_min,
+                   *, n, sm, rcap):
     """Single-dispatch frontier: run rounds rho_min+t for t in [t0, rcap)
     under a device while-loop until no chain has a candidate, writing
     into the [rcap, n] tables (rows >= t0 are overwritten; rows < t0 are
@@ -188,7 +184,7 @@ def frontier_sweep(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
     re-run with a larger bucket."""
     k_cap = chain_la.shape[1]
     step = make_round_step(chain_la, chain_rbase, chain_len, la, fd, rbase,
-                           chain, n=n, sm=sm, pos2k=pos2k)
+                           chain, pos2k, n=n, sm=sm)
 
     def cond(carry):
         t, active, *_ = carry
@@ -224,6 +220,7 @@ def rounds_from_frontier(frontier, creator, index, self_parent, rho_min, *, n):
 def compute_frontier(la, rbase, fd, chain, chain_len, root_round,
                      *, n: int, sm: int, rc: int = 64,
                      view_chain_len: Optional[np.ndarray] = None,
+                     pos2k=None,
                      ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Host driver: sweep rounds in chunks of rc until the frontier
     passes every chain's end. `view_chain_len` restricts to an
@@ -233,6 +230,8 @@ def compute_frontier(la, rbase, fd, chain, chain_len, root_round,
     frontier[R', n], rho_min)."""
     chain_len_eff = chain_len if view_chain_len is None else view_chain_len
     chain_la, chain_rbase = build_chain_tables(la, rbase, chain, n=n)
+    if pos2k is None:
+        pos2k = first_descendant_cube(la, chain, chain_len, n=n)
     rho_min = int(root_round.min()) + 1
 
     wt_prev = jnp.full((n,), -1, dtype=jnp.int32)
@@ -242,7 +241,7 @@ def compute_frontier(la, rbase, fd, chain, chain_len, root_round,
     while True:
         wt_o, fr_o, act, wt_prev, fr_prev = frontier_chunk(
             chain_la, chain_rbase, chain_len_eff, la, fd, rbase, chain,
-            wt_prev, fr_prev, jnp.int32(rho0), n=n, sm=sm, rc=rc)
+            pos2k, wt_prev, fr_prev, jnp.int32(rho0), n=n, sm=sm, rc=rc)
         act_np = np.asarray(act)
         wt_rows.append(np.asarray(wt_o))
         fr_rows.append(np.asarray(fr_o))

@@ -156,6 +156,15 @@ class IncrementalEngine:
                  block: int = 256, k_capacity: int = 64):
         if n < 1:
             raise ValueError("need at least one participant")
+        if n > 256 and jax.default_backend() == "tpu":
+            import logging
+
+            logging.getLogger("babble_tpu").warning(
+                "IncrementalEngine at n=%d on TPU: the frontier sweep is "
+                "known to kernel-fault at n=1024 on the tunneled axon "
+                "runtime (ops/frontier.py); one-shot consensus via "
+                "run_pipeline(engine='wavefront') is the validated path "
+                "at this scale", n)
         self.n = n
         self.sm = 2 * n // 3 + 1
         self.block = block
@@ -350,10 +359,8 @@ class IncrementalEngine:
         # entries legitimately change when descendants arrive). The
         # pos2k cube doubles as the frontier's per-round strongly-see
         # lookup table when it fits ([n^3] working set in the sweep).
-        cube = kernels.first_descendant_cube(la, chain_d, chain_len_d, n=n)
-        fd = kernels.fd_from_cube(cube, cr_d, idx_d, n=n)
-        pos2k = cube if n * n * n <= (1 << 24) else None
-        del cube  # at large n the [n, n, kcap] table is HBM-heavy
+        pos2k = kernels.first_descendant_cube(la, chain_d, chain_len_d, n=n)
+        fd = kernels.fd_from_cube(pos2k, cr_d, idx_d, n=n)
         _mark("fd", fd)
 
         # 3. Witness frontier, warm-started at the first growable row.
@@ -384,8 +391,8 @@ class IncrementalEngine:
             fr_tab[:t0] = self._fr_table[:t0]
             wt_tab_d, fr_tab_d, t_end = frontier.frontier_sweep(
                 chain_la, chain_rbase, chain_len_d, la, fd, rb, chain_d,
-                jnp.asarray(wt_tab), jnp.asarray(fr_tab), wt_prev, fr_prev,
-                jnp.int32(t0), jnp.int32(self.rho_min), pos2k, n=n, sm=sm,
+                pos2k, jnp.asarray(wt_tab), jnp.asarray(fr_tab), wt_prev,
+                fr_prev, jnp.int32(t0), jnp.int32(self.rho_min), n=n, sm=sm,
                 rcap=rcap)
             t_end = int(t_end)
             if t_end < rcap:

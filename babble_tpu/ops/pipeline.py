@@ -121,15 +121,20 @@ def run_pipeline_wavefront(dag):
     return rounds, wit, wt, pad_famous(famous_small, r_bound, n), rr, cts
 
 
-def _default_engine() -> str:
+def _default_engine(n: int) -> str:
     """Hardware-adaptive default: the block-closure/round-frontier path
     trades FLOPs (dense boolean matmuls) for sequential trip count —
     the right trade on a TPU MXU, the wrong one on a host CPU where
     dispatch is cheap and FLOPs are scarce. Tests and the CPU bench
-    fallback therefore keep the wavefront."""
+    fallback therefore keep the wavefront, as does large n on TPU: the
+    composed frontier step kernel-faults at n=1024 on the tunneled axon
+    runtime (ops/frontier.py make_round_step), so the wavefront is the
+    validated engine at that scale."""
     import jax
 
-    return "closure" if jax.default_backend() not in ("cpu",) else "wavefront"
+    if jax.default_backend() in ("cpu",) or n > 256:
+        return "wavefront"
+    return "closure"
 
 
 def run_pipeline(dag, block: int = 512, engine: str = "auto"):
@@ -147,17 +152,22 @@ def run_pipeline(dag, block: int = 512, engine: str = "auto"):
     from . import closure, frontier
 
     if engine == "auto":
-        engine = _default_engine()
+        engine = _default_engine(dag.n)
     if engine == "wavefront":
         return run_pipeline_wavefront(dag)
 
     n, sm = dag.n, dag.super_majority
     block = min(block, max(64, 1 << (dag.e - 1).bit_length())) if dag.e else 64
     la, rbase = closure.coordinates(dag, block=block)
-    fd = kernels.compute_first_descendants(
-        la, dag.creator, dag.index, dag.chain, dag.chain_len, n=n)
+    # One cube serves both the per-event fd gather and the frontier's
+    # per-round strongly-see lookups.
+    pos2k = kernels.first_descendant_cube(
+        la, jax.numpy.asarray(dag.chain), jax.numpy.asarray(dag.chain_len),
+        n=n)
+    fd = kernels.fd_from_cube(pos2k, dag.creator, dag.index, n=n)
     wt_np, fr_rel, rho_min = frontier.compute_frontier(
-        la, rbase, fd, dag.chain, dag.chain_len, dag.root_round, n=n, sm=sm)
+        la, rbase, fd, dag.chain, dag.chain_len, dag.root_round, n=n, sm=sm,
+        pos2k=pos2k)
     e = dag.e
     rounds, wit = frontier.rounds_from_frontier(
         fr_rel, dag.creator[:e], dag.index[:e], dag.self_parent[:e],

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Poll every node's /Stats once per second — reference
+# docker/watcher/watch.sh:1-12.
+set -u
+NODES="${NODES:-4}"
+while true; do
+  for i in $(seq 1 "$NODES"); do
+    echo "--- node$i ---"
+    curl -fsS "http://node$i:80/Stats" || echo "down"
+    echo
+  done
+  sleep 1
+done

@@ -1,0 +1,68 @@
+# N-node babble-tpu testnet on GCP TPU VMs — the reference's AWS
+# deployment (terraform/example.tf) re-targeted at TPU hardware.
+provider "google" {
+  project = var.project
+  region  = var.region
+  zone    = var.zone
+}
+
+resource "google_compute_network" "babblenet" {
+  name                    = "babblenet"
+  auto_create_subnetworks = true
+}
+
+# Internal gossip + RPC traffic between nodes, maintenance SSH, and the
+# public /Stats port — mirrors the reference security group
+# (terraform/example.tf:17-60).
+resource "google_compute_firewall" "babble_internal" {
+  name    = "babble-internal"
+  network = google_compute_network.babblenet.name
+  allow {
+    protocol = "tcp"
+    ports    = ["1337", "1338", "1339"]
+  }
+  source_tags = ["babble"]
+  target_tags = ["babble"]
+}
+
+resource "google_compute_firewall" "babble_admin" {
+  name    = "babble-admin"
+  network = google_compute_network.babblenet.name
+  allow {
+    protocol = "tcp"
+    ports    = ["22", "80"]
+  }
+  source_ranges = ["0.0.0.0/0"]
+  target_tags   = ["babble"]
+}
+
+resource "google_storage_bucket" "conf" {
+  name          = "${var.project}-babble-conf"
+  location      = var.region
+  force_destroy = true
+}
+
+resource "google_tpu_v2_vm" "babble" {
+  count            = var.nodes
+  name             = "babble-${count.index}"
+  zone             = var.zone
+  accelerator_type = var.accelerator_type
+  runtime_version  = var.runtime_version
+  network_config {
+    network     = google_compute_network.babblenet.id
+    enable_external_ips = true
+  }
+  tags = ["babble"]
+  metadata = {
+    node-index     = count.index
+    conf-bucket    = google_storage_bucket.conf.name
+    startup-script = file("${path.module}/scripts/startup.sh")
+  }
+}
+
+output "service_endpoints" {
+  value = [
+    for vm in google_tpu_v2_vm.babble :
+    "http://${vm.network_endpoints[0].access_config[0].external_ip}:80/Stats"
+  ]
+}

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Flood Babble.SubmitTx at a node's app proxy — identical wire protocol
+# to demo/scripts/bombard.sh, pointed at a VM's external IP.
+set -euo pipefail
+HOST="${1:?usage: bombard.sh <host> [count]}" COUNT="${2:-200}"
+python - "$HOST" "$COUNT" <<'PY'
+import base64, json, socket, sys, time
+host, count = sys.argv[1], int(sys.argv[2])
+s = socket.create_connection((host, 1338), timeout=5)
+f = s.makefile("rw")
+for i in range(count):
+    tx = base64.b64encode(f"bombard tx {i}".encode()).decode()
+    f.write(json.dumps(
+        {"method": "Babble.SubmitTx", "params": [tx], "id": i}) + "\n")
+    f.flush()
+    json.loads(f.readline())
+    time.sleep(0.003)
+print(f"submitted {count} transactions to {host}:1338")
+PY

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Generate N keypairs + the shared peers.json and upload them to the
+# config bucket — the reference's conf generation
+# (terraform/scripts/build-conf.sh) with GCS instead of scp.
+set -euo pipefail
+NODES="${1:-4}" BUCKET="${2:?usage: build-conf.sh <nodes> <gcs-bucket>}"
+TMP=$(mktemp -d)
+python - "$NODES" "$TMP" <<'PY'
+import json, subprocess, sys
+n, tmp = int(sys.argv[1]), sys.argv[2]
+pubs = []
+for i in range(n):
+    out = subprocess.run(
+        [sys.executable, "-m", "babble_tpu.cli", "keygen",
+         "--datadir", f"{tmp}/node{i}"],
+        check=True, capture_output=True, text=True).stdout
+    pubs.append(out.split("PublicKey: ")[1].split()[0])
+peers = [{"NetAddr": f"babble-{i}:1337", "PubKeyHex": pubs[i]}
+         for i in range(n)]
+for i in range(n):
+    json.dump(peers, open(f"{tmp}/node{i}/peers.json", "w"))
+PY
+gsutil -m cp -r "$TMP"/node* "gs://$BUCKET/"
+echo "uploaded conf for $NODES nodes to gs://$BUCKET"

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# TPU VM startup: install the package, pull this node's datadir from
+# the conf bucket, run the node with the consensus pipeline on the
+# chip. The reference's AWS user-data script is the analog.
+set -euo pipefail
+IDX=$(curl -fs -H "Metadata-Flavor: Google" \
+  "http://metadata/computeMetadata/v1/instance/attributes/node-index")
+BUCKET=$(curl -fs -H "Metadata-Flavor: Google" \
+  "http://metadata/computeMetadata/v1/instance/attributes/conf-bucket")
+pip install "jax[tpu]" numpy cryptography
+gsutil -m cp -r "gs://$BUCKET/node$IDX" /opt/babble-conf
+exec python -m babble_tpu.cli run \
+  --datadir /opt/babble-conf \
+  --node_addr "babble-$IDX:1337" \
+  --proxy_addr "0.0.0.0:1338" \
+  --client_addr "127.0.0.1:1339" \
+  --service_addr "0.0.0.0:80" \
+  --engine tpu --heartbeat 50

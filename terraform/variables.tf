@@ -1,0 +1,24 @@
+variable "project" { type = string }
+variable "region" {
+  type    = string
+  default = "us-central1"
+}
+variable "zone" {
+  type    = string
+  default = "us-central1-a"
+}
+variable "nodes" {
+  type    = number
+  default = 4
+}
+# TPU-first: each consensus node is a TPU VM so the batched pipeline
+# (--engine tpu) runs on a real chip; the reference used t2.micro
+# (terraform/variables.tf) because its hot loop was host-bound Go.
+variable "accelerator_type" {
+  type    = string
+  default = "v5litepod-1"
+}
+variable "runtime_version" {
+  type    = string
+  default = "v2-alpha-tpuv5-lite"
+}

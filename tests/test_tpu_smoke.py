@@ -1,0 +1,70 @@
+"""Opt-in real-hardware smoke: run the one-shot and incremental engines
+on the actual TPU backend (the place the round-2 bench failure lived —
+the rest of the suite runs on the virtual CPU mesh and can never catch
+a chip-side regression).
+
+Gated behind BABBLE_TPU_TESTS=1 because the chip sits behind a tunnel
+that is transiently unavailable; the bench has its own bounded-retry
+armor, tests should not flake CI. Run with:
+
+    BABBLE_TPU_TESTS=1 python -m pytest tests/test_tpu_smoke.py -v
+
+The child process is spawned WITHOUT the conftest's forced-CPU
+environment so it initializes the real backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import json, sys
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import jax
+backend = jax.default_backend()
+from babble_tpu.ops.dag import synthetic_dag
+from babble_tpu.ops.pipeline import run_pipeline
+from babble_tpu.ops.incremental import IncrementalEngine
+
+dag, _ = synthetic_dag(8, 256, seed=0)
+rounds, wit, wt, famous, rr, cts = map(np.asarray, run_pipeline(dag))
+
+eng = IncrementalEngine(8, capacity=64, block=64, k_capacity=8)
+for k in range(0, 256, 64):
+    eng.append_batch(dag.self_parent[k:k+64], dag.other_parent[k:k+64],
+                     dag.creator[k:k+64], dag.index[k:k+64],
+                     dag.coin[k:k+64], np.arange(k, k+64))
+    eng.run()
+ok = bool((eng.rounds[:256] == rounds).all() and (eng.rr[:256] == rr).all())
+print(json.dumps({"backend": backend, "consensus": int((rr >= 0).sum()),
+                  "incremental_parity": ok}))
+"""
+
+
+@pytest.mark.skipif(
+    os.environ.get("BABBLE_TPU_TESTS") != "1",
+    reason="real-TPU smoke is opt-in (BABBLE_TPU_TESTS=1)",
+)
+def test_engines_on_real_tpu():
+    env = dict(os.environ)
+    # undo the conftest's virtual-CPU forcing for the child
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD % {"repo": REPO}],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    info = json.loads(out.stdout.strip().splitlines()[-1])
+    assert info["backend"] == "tpu", f"expected the real chip, got {info}"
+    assert info["consensus"] > 100
+    assert info["incremental_parity"], "incremental != one-shot on TPU"
