@@ -20,10 +20,11 @@ the docstrings below:
 with rbase the ancestor-max of the root contribution (computed by
 ops/closure.py). Along each creator chain both conditions are monotone
 in chain position, so the first position with round >= rho is a closed
-form: a searchsorted for rbase, and for strongly-see a double
-kth-smallest over per-coordinate searchsorted positions (strongly-see
-counts are monotone along chains because chain lastAncestors are
-sorted). A one-shot skip-correction then removes candidates whose round
+form: a compare-and-count for rbase, and for strongly-see a vectorized
+binary search over positions (the per-position strongly-seen-witness
+count is monotone along chains because chain lastAncestors are
+sorted — see make_round_step). A one-shot skip-correction then removes
+candidates whose round
 exceeds rho (round skips happen when a peer rejoins after missing
 rounds): a candidate y is round->rho iff it neither carries
 rbase >= rho+1 nor strongly sees >= sm of the candidate row itself —
@@ -43,7 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .kernels import INT32_MAX, first_descendant_cube
+from .kernels import INT32_MAX
 
 # Working-set bound for the per-round [chains, coords, witnesses]
 # searchsorted cube: chains are processed in chunks so each materialized
@@ -72,25 +73,23 @@ def build_chain_tables(la, rbase, chain, *, n):
 
 
 def make_round_step(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
-                    pos2k, *, n, sm):
+                    *, n, sm):
     """One frontier round: step(rho, wt_prev, fr_prev) ->
     (wt_row, fr_unclamped, fr_clamped, any_candidate). Shared by the
     chunked host driver below and the single-dispatch while-loop sweep
     (used by ops/incremental.py).
 
-    `pos2k` (the kernels.first_descendant_cube [c, i, t] table) turns
-    the per-round strongly-see search into a gather:
-    k_ci[c, i, w] = pos2k[c, i, fd[w, i]] — both sides are positions on
-    chain i, so the precomputed inverse lookup answers every round.
-    (vmapped binary searches are both slow and, on some TPU runtimes,
-    kernel-fault-prone at n=1024; everything here is dense compares and
-    gathers, chunked over chains to bound the [cc, n, n] working set.
-    Known issue: on the tunneled axon runtime the composed step still
-    faults at n=1024 — the wavefront engine (pipeline.py) is the
-    validated path at that scale; parity on CPU/virtual meshes holds at
-    all sizes.)"""
+    k2 is a vectorized binary search: because per-witness strongly-see
+    indicators are monotone along a chain, "sm-th smallest over w of
+    the per-w first position" equals "first position whose event
+    strongly sees >= sm witnesses" — so log2(K) probe steps, each one
+    dense compare-and-count over a [cc, w, i] chunked cube, replace the
+    earlier per-(c, i, w) lookup + double sort (1M length-K sorts per
+    round at n=1024, and an XLA fusion of the gather+sort composition
+    that kernel-faulted on the tunneled axon runtime)."""
     k_cap = chain_la.shape[1]
     cc = n // _chain_chunks(n)
+    probes = max(int(np.ceil(np.log2(max(k_cap, 2)))), 1) + 1
 
     def step(rho, wt_prev, fr_prev):
         # k1: first chain position whose propagated root contribution
@@ -101,27 +100,37 @@ def make_round_step(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
         # k2: first position strongly seeing >= sm of wt_prev.
         wt_valid = wt_prev >= 0
         fdw = fd[jnp.where(wt_valid, wt_prev, 0)]  # [w, i]
+        fdw_row = jnp.where(wt_valid[:, None], fdw, INT32_MAX)
 
-        # first_k_ss[c, w] = sm-th smallest over i of
-        # k_ci[c, i, w] = first k with chain_la[c, k, i] >= fd[w, i].
-        t_idx = jnp.clip(fdw.T, 0, k_cap - 1)  # [i, w]
-        t_bc = jnp.broadcast_to(t_idx[None], (cc, n, n))
-        fdw_ok = (fdw.T < INT32_MAX)[None]
+        def sees_sm(mid):
+            """ok[c] = chain_la[c, mid[c]] strongly sees >= sm valid
+            witnesses (positions beyond the chain are INT32_MAX rows
+            and are guarded by the callers' chain_len clamp)."""
+            x_row = chain_la[jnp.arange(n), jnp.clip(mid, 0, k_cap - 1)]
 
-        def chain_chunk(g, acc):
-            c0 = g * cc
-            p2k_g = lax.dynamic_slice(pos2k, (c0, 0, 0), (cc, n, k_cap))
-            k_ci = jnp.take_along_axis(p2k_g, t_bc, axis=2)
-            k_ci = jnp.where(fdw_ok, k_ci, INT32_MAX)
-            part = jnp.sort(k_ci, axis=1)[:, sm - 1, :]  # [cc, w]
-            return lax.dynamic_update_slice(acc, part, (c0, 0))
+            def chunk(g, acc):
+                c0 = g * cc
+                x_g = lax.dynamic_slice(x_row, (c0, 0), (cc, n))
+                ss = (x_g[:, None, :] >= fdw_row[None, :, :]).sum(-1) >= sm
+                cnt = ss.sum(-1, dtype=jnp.int32)  # [cc]
+                return lax.dynamic_update_slice(acc, cnt, (c0,))
 
-        first_k_ss = lax.fori_loop(
-            0, n // cc, chain_chunk,
-            jnp.full((n, n), INT32_MAX, dtype=jnp.int32))
-        first_k_ss = jnp.where(wt_valid[None, :], first_k_ss, INT32_MAX)
-        # k2[c] = sm-th smallest over w (needs sm witnesses seen)
-        k2 = jnp.sort(first_k_ss, axis=1)[:, sm - 1]
+            cnt = lax.fori_loop(
+                0, n // cc, chunk, jnp.zeros((n,), dtype=jnp.int32))
+            return cnt >= sm
+
+        def probe(_, carry):
+            lo, hi = carry
+            mid = (lo + hi) // 2
+            ok = sees_sm(mid) & (mid < hi)
+            hi = jnp.where(ok, mid, hi)
+            lo = jnp.where(ok | (lo >= hi), lo, mid + 1)
+            return lo, hi
+
+        # search in [0, chain_len]; hi == chain_len means no position
+        lo0 = jnp.zeros((n,), jnp.int32)
+        _, k2 = lax.fori_loop(0, probes, probe, (lo0, chain_len))
+        k2 = jnp.where(k2 < chain_len, k2, INT32_MAX)
 
         fr = jnp.maximum(jnp.minimum(k1, k2), fr_prev)
         cand_valid = fr < chain_len
@@ -145,7 +154,7 @@ def make_round_step(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
 
 @functools.partial(jax.jit, static_argnames=("n", "sm", "rc"))
 def frontier_chunk(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
-                   pos2k, wt_prev, fr_prev, rho0, *, n, sm, rc):
+                   wt_prev, fr_prev, rho0, *, n, sm, rc):
     """Advance the witness frontier by `rc` rounds starting at rho0.
 
     wt_prev: [n] witness event ids of round rho0-1 (-1 none);
@@ -154,7 +163,7 @@ def frontier_chunk(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
     """
     k_cap = chain_la.shape[1]
     step = make_round_step(chain_la, chain_rbase, chain_len, la, fd, rbase,
-                           chain, pos2k, n=n, sm=sm)
+                           chain, n=n, sm=sm)
 
     def round_step(t, carry):
         wt_prev, fr_prev, wt_out, fr_out, act_out = carry
@@ -174,7 +183,7 @@ def frontier_chunk(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
 
 @functools.partial(jax.jit, static_argnames=("n", "sm", "rcap"))
 def frontier_sweep(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
-                   pos2k, wt_tab, fr_tab, wt_prev, fr_prev, t0, rho_min,
+                   wt_tab, fr_tab, wt_prev, fr_prev, t0, rho_min,
                    *, n, sm, rcap):
     """Single-dispatch frontier: run rounds rho_min+t for t in [t0, rcap)
     under a device while-loop until no chain has a candidate, writing
@@ -184,7 +193,7 @@ def frontier_sweep(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
     re-run with a larger bucket."""
     k_cap = chain_la.shape[1]
     step = make_round_step(chain_la, chain_rbase, chain_len, la, fd, rbase,
-                           chain, pos2k, n=n, sm=sm)
+                           chain, n=n, sm=sm)
 
     def cond(carry):
         t, active, *_ = carry
@@ -220,7 +229,6 @@ def rounds_from_frontier(frontier, creator, index, self_parent, rho_min, *, n):
 def compute_frontier(la, rbase, fd, chain, chain_len, root_round,
                      *, n: int, sm: int, rc: int = 64,
                      view_chain_len: Optional[np.ndarray] = None,
-                     pos2k=None,
                      ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Host driver: sweep rounds in chunks of rc until the frontier
     passes every chain's end. `view_chain_len` restricts to an
@@ -230,8 +238,6 @@ def compute_frontier(la, rbase, fd, chain, chain_len, root_round,
     frontier[R', n], rho_min)."""
     chain_len_eff = chain_len if view_chain_len is None else view_chain_len
     chain_la, chain_rbase = build_chain_tables(la, rbase, chain, n=n)
-    if pos2k is None:
-        pos2k = first_descendant_cube(la, chain, chain_len, n=n)
     rho_min = int(root_round.min()) + 1
 
     wt_prev = jnp.full((n,), -1, dtype=jnp.int32)
@@ -241,7 +247,7 @@ def compute_frontier(la, rbase, fd, chain, chain_len, root_round,
     while True:
         wt_o, fr_o, act, wt_prev, fr_prev = frontier_chunk(
             chain_la, chain_rbase, chain_len_eff, la, fd, rbase, chain,
-            pos2k, wt_prev, fr_prev, jnp.int32(rho0), n=n, sm=sm, rc=rc)
+            wt_prev, fr_prev, jnp.int32(rho0), n=n, sm=sm, rc=rc)
         act_np = np.asarray(act)
         wt_rows.append(np.asarray(wt_o))
         fr_rows.append(np.asarray(fr_o))

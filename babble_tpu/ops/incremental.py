@@ -356,11 +356,9 @@ class IncrementalEngine:
         _mark("coords", la)
 
         # 2. First descendants (closed form, full recompute: old events'
-        # entries legitimately change when descendants arrive). The
-        # pos2k cube doubles as the frontier's per-round strongly-see
-        # lookup table when it fits ([n^3] working set in the sweep).
-        pos2k = kernels.first_descendant_cube(la, chain_d, chain_len_d, n=n)
-        fd = kernels.fd_from_cube(pos2k, cr_d, idx_d, n=n)
+        # entries legitimately change when descendants arrive).
+        fd = kernels.compute_first_descendants(
+            la, cr_d, idx_d, chain_d, chain_len_d, n=n)
         _mark("fd", fd)
 
         # 3. Witness frontier, warm-started at the first growable row.
@@ -391,7 +389,7 @@ class IncrementalEngine:
             fr_tab[:t0] = self._fr_table[:t0]
             wt_tab_d, fr_tab_d, t_end = frontier.frontier_sweep(
                 chain_la, chain_rbase, chain_len_d, la, fd, rb, chain_d,
-                pos2k, jnp.asarray(wt_tab), jnp.asarray(fr_tab), wt_prev,
+                jnp.asarray(wt_tab), jnp.asarray(fr_tab), wt_prev,
                 fr_prev, jnp.int32(t0), jnp.int32(self.rho_min), n=n, sm=sm,
                 rcap=rcap)
             t_end = int(t_end)

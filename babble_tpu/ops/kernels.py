@@ -69,9 +69,7 @@ def first_descendant_cube(la, chain, chain_len, *, n):
     last_anc[chain[c, k], i] is monotone nondecreasing in k, so the
     answer is one searchsorted per (c, i) column.
 
-    The cube is the shared primitive: per-event fd gathers from it
-    (fd_from_cube) and the round-frontier sweep turns its per-round
-    strongly-see searches into gathers (ops/frontier.py)."""
+    Per-event first descendants gather from the cube (fd_from_cube)."""
     k = chain.shape[1]
     chain_valid = chain >= 0
     # [n, K, n]; pad slots sort to the top so searchsorted lands on them

@@ -159,15 +159,10 @@ def run_pipeline(dag, block: int = 512, engine: str = "auto"):
     n, sm = dag.n, dag.super_majority
     block = min(block, max(64, 1 << (dag.e - 1).bit_length())) if dag.e else 64
     la, rbase = closure.coordinates(dag, block=block)
-    # One cube serves both the per-event fd gather and the frontier's
-    # per-round strongly-see lookups.
-    pos2k = kernels.first_descendant_cube(
-        la, jax.numpy.asarray(dag.chain), jax.numpy.asarray(dag.chain_len),
-        n=n)
-    fd = kernels.fd_from_cube(pos2k, dag.creator, dag.index, n=n)
+    fd = kernels.compute_first_descendants(
+        la, dag.creator, dag.index, dag.chain, dag.chain_len, n=n)
     wt_np, fr_rel, rho_min = frontier.compute_frontier(
-        la, rbase, fd, dag.chain, dag.chain_len, dag.root_round, n=n, sm=sm,
-        pos2k=pos2k)
+        la, rbase, fd, dag.chain, dag.chain_len, dag.root_round, n=n, sm=sm)
     e = dag.e
     rounds, wit = frontier.rounds_from_frontier(
         fr_rel, dag.creator[:e], dag.index[:e], dag.self_parent[:e],

@@ -426,8 +426,13 @@ def child():
         log(f"  DAG gen {time.monotonic() - t0:.1f}s, "
             f"levels={dag.levels.shape}")
         try:
+            # Engine choice flips with n (the frontier sweep's trip
+            # count is the round count, which shrinks as n grows), so
+            # re-tune at this size instead of reusing the headline's.
+            engine_ns = tune_engine(dag, s_rank)
+            log(f"  tuned northstar engine: {engine_ns}")
             best, n_cons, max_round = time_pipeline(dag, s_rank, warm=1,
-                                                    reps=2, engine=engine)
+                                                    reps=2, engine=engine_ns)
             eps = n_cons / best
             log(f"  northstar: {best * 1e3:.1f} ms -> {n_cons} consensus "
                 f"({eps:,.0f} ev/s), last round {max_round}")
