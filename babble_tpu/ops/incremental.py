@@ -81,20 +81,54 @@ def _closure_update(la, rb, self_parent, other_parent, creator, index,
     return lax.fori_loop(b0, b1, body, (la, rb))
 
 
-@functools.partial(jax.jit, static_argnames=("n", "iw"))
-def _decide_rr_window(rounds, rr_prev, wt_win, famous_win, elig, la, fd,
-                      creator, index, chain_rank, i0, *, n, iw):
-    """kernels.decide_round_received restricted to candidate rounds
-    [i0, i0+iw). `elig` [iw] is the host-computed reference gating
-    (round fully decided AND every earlier round decided,
-    hashgraph.go:762-764); `rr_prev` keeps already-assigned rounds
-    (assignments are final). Returns (rr, cts_rank) with cts computed
-    only for newly-assigned events."""
+@functools.partial(jax.jit, static_argnames=("n", "sm", "rw", "iw"))
+def _fused_fame_rr(wt_win, famous_prev_win, in_list_win, wt_rr, fam_low_rr,
+                   elig_low, rounds, rr_prev, la, fd, creator, index, coin,
+                   chain_rank, rx0, i0, *, n, sm, rw, iw):
+    """Fame + round-received in one dispatch (one host sync per run).
+
+    Fame runs over the window [rx0, rx0+rw) and is merged on device
+    under the reference's undecided-rounds gating (`in_list_win`
+    mirrors hashgraph.go:629-637: only rounds still queued accept fame;
+    a straggler witness in a removed round stays UNDEFINED). Round
+    received then sweeps candidate rounds [i0, i0+iw) — i0 can precede
+    rx0, so the rr windows (`wt_rr`, `fam_low_rr`, `elig_low`) are
+    host-built at offset i0, and rows at i >= rx0 take this call's
+    merged fame and a device-derived eligibility: round fully decided
+    AND below the post-merge first undecided round
+    (hashgraph.go:762-764). rr assignments are final; `rr_prev` keeps
+    them. Returns (famous_merged, rr, cts_rank) with cts only for
+    newly-assigned events."""
     e = rounds.shape[0]
     k = chain_rank.shape[1]
-    wt_valid = wt_win >= 0
-    wt_safe = jnp.where(wt_valid, wt_win, 0)
-    fmask = (famous_win == FAME_TRUE) & wt_valid
+
+    famous_comp = kernels.decide_fame(
+        wt_win, la, fd, index, coin, n=n, sm=sm, r=rw)
+    wt_valid_f = wt_win >= 0
+    mergeable = (
+        in_list_win[:, None] & wt_valid_f
+        & (famous_prev_win == FAME_UNDEFINED)
+    )
+    famous_merged = jnp.where(mergeable, famous_comp, famous_prev_win)
+    undec_row = (wt_valid_f & (famous_merged == FAME_UNDEFINED)).any(1)
+    still_listed = in_list_win & undec_row
+    t_first = jnp.min(
+        jnp.where(still_listed, jnp.arange(rw), jnp.iinfo(jnp.int32).max // 2)
+    )
+    first_undec = rx0 + t_first  # huge when the list empties
+
+    # Combined rr-window fame/eligibility: host values below rx0,
+    # this call's merged values at and above it.
+    i_vec = i0 + jnp.arange(iw)
+    t2 = jnp.clip(i_vec - rx0, 0, rw - 1)
+    in_fame_win = i_vec >= rx0
+    fam_rr = jnp.where(in_fame_win[:, None], famous_merged[t2], fam_low_rr)
+    decided_vec = jnp.where(in_fame_win, ~undec_row[t2], elig_low)
+    elig = decided_vec & (first_undec > i_vec)
+
+    wt_valid = wt_rr >= 0
+    wt_safe = jnp.where(wt_valid, wt_rr, 0)
+    fmask = (fam_rr == FAME_TRUE) & wt_valid
     fcnt = fmask.sum(1)
     idx_w = jnp.where(wt_valid, index[wt_safe], -1)
     creator_e = creator[:e]
@@ -125,7 +159,7 @@ def _decide_rr_window(rounds, rr_prev, wt_win, famous_win, elig, la, fd,
     sorted_t = jnp.sort(tvals, axis=1)
     med = jnp.take_along_axis(sorted_t, (s_cnt // 2)[:, None], axis=1)[:, 0]
     cts = jnp.where(newly, med, ZERO_TS_RANK)
-    return rr, cts
+    return famous_merged, rr, cts
 
 
 @dataclass
@@ -218,7 +252,7 @@ class IncrementalEngine:
         # Per-phase wall time (ns) of the last run(), mirroring the
         # reference's phase logging around the consensus pipeline
         # (node/core.go:278-296). Keys: coords, fd, frontier, rounds,
-        # fame, rr.
+        # fame_rr.
         self.phase_ns: dict = {}
 
     # -- append ------------------------------------------------------------
@@ -436,15 +470,64 @@ class IncrementalEngine:
 
         _mark("rounds")
 
-        # 5. Fame over the window [rx0, r_total).
-        if self.undecided_rounds and self.undecided_rounds[0] < r_total:
-            rx0 = self.undecided_rounds[0]
-            rw = _pow2(r_total - rx0)
+        # 5+6. Fame and round-received fused into one dispatch: the
+        # device merges fame under the undecided-rounds gating and
+        # derives the rr eligibility from the merged state, so the run
+        # costs one host sync here instead of two.
+        rx0 = (
+            self.undecided_rounds[0]
+            if self.undecided_rounds else r_total)
+        i0 = self._prev_first_undec
+        if min_new_round is not None:
+            i0 = min(i0, min_new_round + 1)
+        if min(rx0, i0) < r_total:
+            rw = _pow2(max(r_total - rx0, 1))
+            iw = _pow2(max(r_total - i0, 1))
+            span_f = max(r_total - rx0, 0)
             wt_win = np.full((rw, n), -1, np.int32)
-            wt_win[: r_total - rx0] = wt_abs[rx0:]
-            famous_win = np.asarray(kernels.decide_fame(
-                jnp.asarray(wt_win), la, fd, idx_d, coin_d,
-                n=n, sm=sm, r=rw))
+            fam_prev_win = np.zeros((rw, n), np.int32)
+            in_list_win = np.zeros(rw, np.bool_)
+            wt_win[:span_f] = wt_abs[rx0:]
+            fam_prev_win[:span_f] = self.famous[rx0:r_total]
+            undecided_set = set(self.undecided_rounds)
+            for t in range(span_f):
+                in_list_win[t] = (rx0 + t) in undecided_set
+
+            span_r = r_total - i0
+            wt_rr = np.full((iw, n), -1, np.int32)
+            fam_low_rr = np.zeros((iw, n), np.int32)
+            elig_low = np.zeros(iw, np.bool_)
+            wt_rr[:span_r] = wt_abs[i0:]
+            for t in range(min(span_r, max(rx0 - i0, 0))):
+                i = i0 + t  # rounds below rx0: fame is frozen host state
+                fam_low_rr[t] = self.famous[i]
+                slots = wt_abs[i] >= 0
+                elig_low[t] = not (
+                    slots & (self.famous[i] == FAME_UNDEFINED)).any()
+
+            # Timestamp ranks are global-sort positions, recomputed per
+            # call because new timestamps interleave with old ones.
+            ts_values, inv = np.unique(self.ts_ns[:e], return_inverse=True)
+            chain_rank = np.full((n, self.kcap), -1, np.int32)
+            valid = self.chain >= 0
+            safe = np.where(valid, self.chain, 0)
+            ranks = inv.astype(np.int32)
+            chain_rank[valid] = ranks[safe[valid]]
+
+            famous_merged_d, rr_new, cts_rank = _fused_fame_rr(
+                jnp.asarray(wt_win), jnp.asarray(fam_prev_win),
+                jnp.asarray(in_list_win), jnp.asarray(wt_rr),
+                jnp.asarray(fam_low_rr), jnp.asarray(elig_low),
+                jnp.asarray(self.rounds[: self.cap]),
+                jnp.asarray(self.rr[: self.cap]),
+                la, fd, cr_d, idx_d, coin_d, jnp.asarray(chain_rank),
+                jnp.int32(rx0), jnp.int32(i0), n=n, sm=sm, rw=rw, iw=iw)
+            famous_merged = np.asarray(famous_merged_d)
+            rr_np = np.asarray(rr_new)
+            cts_np = np.asarray(cts_rank)
+
+            # Host mirror of DecideFame's bookkeeping from the pulled
+            # fame window (hashgraph.go:649-730).
             for rho in list(self.undecided_rounds):
                 if rho >= r_total:
                     continue
@@ -454,7 +537,7 @@ class IncrementalEngine:
                     if wt_abs[rho, c] < 0:
                         continue
                     if self.famous[rho, c] == FAME_UNDEFINED:
-                        f = int(famous_win[t, c])
+                        f = int(famous_merged[t, c])
                         if f != FAME_UNDEFINED:
                             self.famous[rho, c] = f
                             delta.fame_updates.append(
@@ -469,50 +552,7 @@ class IncrementalEngine:
                         self.last_consensus_round = rho
                         delta.last_commited_round_events = int(
                             (self.rounds[:e] == rho - 1).sum())
-        delta.last_consensus_round = self.last_consensus_round
-        _mark("fame")
 
-        # 6. Round received over the window [i0, r_total).
-        first_undec = (
-            self.undecided_rounds[0] if self.undecided_rounds else r_total)
-        i0 = self._prev_first_undec
-        if min_new_round is not None:
-            i0 = min(i0, min_new_round + 1)
-        self._prev_first_undec = first_undec
-        if i0 < r_total:
-            iw = _pow2(r_total - i0)
-            wt_win = np.full((iw, n), -1, np.int32)
-            fam_win = np.zeros((iw, n), np.int32)
-            span = r_total - i0
-            wt_win[:span] = wt_abs[i0:]
-            fam_win[:span] = self.famous[i0:r_total]
-            decided_row = np.ones(r_total, np.bool_)
-            for rho in range(r_total):
-                slots = wt_abs[rho] >= 0
-                decided_row[rho] = not (
-                    slots & (self.famous[rho] == FAME_UNDEFINED)).any()
-            elig = np.zeros(iw, np.bool_)
-            for t in range(span):
-                i = i0 + t
-                elig[t] = bool(decided_row[i]) and first_undec > i
-
-            # Timestamp ranks are global-sort positions, recomputed per
-            # call because new timestamps interleave with old ones.
-            ts_values, inv = np.unique(self.ts_ns[:e], return_inverse=True)
-            chain_rank = np.full((n, self.kcap), -1, np.int32)
-            valid = self.chain >= 0
-            safe = np.where(valid, self.chain, 0)
-            ranks = inv.astype(np.int32)
-            chain_rank[valid] = ranks[safe[valid]]
-
-            rr_new, cts_rank = _decide_rr_window(
-                jnp.asarray(self.rounds[: self.cap]),
-                jnp.asarray(self.rr[: self.cap]),
-                jnp.asarray(wt_win), jnp.asarray(fam_win),
-                jnp.asarray(elig), la, fd, cr_d, idx_d,
-                jnp.asarray(chain_rank), jnp.int32(i0), n=n, iw=iw)
-            rr_np = np.asarray(rr_new)
-            cts_np = np.asarray(cts_rank)
             newly = (rr_np >= 0) & (self.rr[: self.cap] < 0)
             newly[e:] = False
             for i in np.nonzero(newly)[0]:
@@ -526,8 +566,11 @@ class IncrementalEngine:
                     ns = int(ts_values[rank])
                     self.cts_ns[i] = ns
                 delta.new_received.append((int(i), rr_i, ns))
+        delta.last_consensus_round = self.last_consensus_round
+        self._prev_first_undec = (
+            self.undecided_rounds[0] if self.undecided_rounds else r_total)
 
-        _mark("rr")
+        _mark("fame_rr")
         self._new_since_run = []
         self._empty_delta_ok = True
         return delta

@@ -299,7 +299,7 @@ def host_engine_events_per_sec(n_peers, n_events, seed=7):
         h.insert_event(ev, True)
     h.run_consensus()
     dt = time.perf_counter() - t0
-    return len(h.consensus_events()) / dt, len(h.consensus_events())
+    return len(h.consensus_events()) / dt, len(h.consensus_events()), dt
 
 
 def child():
@@ -364,7 +364,7 @@ def child():
         host_n_events = 5000
         log(f"stage host baseline: n=64 e={host_n_events} "
             "(same topology family)")
-        host_eps, host_done = host_engine_events_per_sec(64, host_n_events)
+        host_eps, host_done, _ = host_engine_events_per_sec(64, host_n_events)
         log(f"  host engine: {host_eps:,.0f} ev/s ({host_done} consensus)")
         payload["host_events_per_s"] = round(host_eps, 1)
         payload["host_events"] = host_n_events
@@ -440,6 +440,31 @@ def child():
             payload["northstar_n"] = n
             payload["northstar_events"] = e
             _emit(payload)
+
+            # Honest wall-clock multiple at this scale (BASELINE.md
+            # driver target: >=100x at n=1024/100k): the host engine
+            # reaches no consensus below ~3n events per round, so its
+            # per-event processing rate (insert + consensus pass) over
+            # a 2k-event prefix is measured and extrapolated to the
+            # full run — labeled as such.
+            if _budget_left() > 120:
+                host_e = 2000
+                log(f"stage northstar host extrapolation: n={n} e={host_e}")
+                # only the insert+consensus span counts (key generation
+                # and event signing setup are excluded on both sides)
+                _, _, host_dt = host_engine_events_per_sec(n, host_e)
+                host_rate = host_e / host_dt
+                extrapolated = e / host_rate
+                payload["northstar_host_rate_events_per_s"] = round(
+                    host_rate, 1)
+                payload["northstar_host_wall_extrapolated_s"] = round(
+                    extrapolated, 1)
+                payload["northstar_wall_speedup_vs_host"] = round(
+                    extrapolated / best, 1)
+                log(f"  host rate {host_rate:.1f} ev/s -> extrapolated "
+                    f"{extrapolated:,.0f}s vs device {best:.1f}s "
+                    f"({extrapolated / best:,.0f}x)")
+                _emit(payload)
         except Exception as exc:  # noqa: BLE001
             log(f"  northstar failed: {exc}")
 
