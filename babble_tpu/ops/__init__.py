@@ -31,7 +31,9 @@ works purely in int32 event ids.
 
 from .dag import DagTensors, build_dag, synthetic_dag
 from .engine import BatchConsensusResult, run_consensus_batch
+from .incremental import IncrementalEngine, RunDelta
 from .pipeline import consensus_pipeline, run_pipeline
+from .sharded import sharded_pipeline
 
 __all__ = [
     "DagTensors",
@@ -41,4 +43,7 @@ __all__ = [
     "run_consensus_batch",
     "consensus_pipeline",
     "run_pipeline",
+    "IncrementalEngine",
+    "RunDelta",
+    "sharded_pipeline",
 ]

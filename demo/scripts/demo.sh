@@ -8,9 +8,12 @@ export NODES BASE_PORT
 ./conf.sh
 ./run-testnet.sh
 trap ./stop.sh EXIT
-sleep 3
+# The device engine spends its first syncs compiling kernels; give it
+# a longer runway than the host engine needs.
+if [ "${ENGINE:-host}" = "tpu" ]; then WARM=30; SETTLE=60; else WARM=3; SETTLE=2; fi
+sleep "${WARM}"
 COUNT="${COUNT:-100}" ./bombard.sh
-sleep 2
+sleep "${SETTLE}"
 for i in $(seq 0 $((NODES - 1))); do
   echo "--- node $i ---"
   curl -fsS "http://127.0.0.1:$((BASE_PORT + 1000 + i))/Stats" && echo
