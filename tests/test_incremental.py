@@ -36,6 +36,22 @@ from test_node import check_gossip, make_nodes, run_gossip
 CACHE = 10000
 
 
+def make_tpu_twin(build):
+    """Host graph with consensus run + a TpuHashgraph fed the same
+    fixture stream (consensus run once at the end)."""
+    h, b = build()
+    h.divide_rounds()
+    h.decide_fame()
+    h.find_order()
+    participants = b.participants()
+    t = TpuHashgraph(participants, InmemStore(participants, CACHE),
+                     capacity=64, block=64)
+    for ev in b.ordered_events:
+        t.insert_event(ev, True)
+    t.run_consensus()
+    return h, b, t
+
+
 @pytest.mark.parametrize(
     "n,e,bs", [(8, 300, 37), (5, 97, 10)], ids=["n8", "n5"]
 )
@@ -125,16 +141,7 @@ def test_tpu_graph_consensus_timestamps():
     """Consensus timestamps (median over famous-witness first
     descendants) must match the host engine exactly — they are the
     second consensus sort key."""
-    h, b = build_consensus_graph()
-    h.divide_rounds()
-    h.decide_fame()
-    h.find_order()
-    participants = b.participants()
-    t = TpuHashgraph(participants, InmemStore(participants, CACHE),
-                     capacity=64, block=64)
-    for ev in b.ordered_events:
-        t.insert_event(ev, True)
-    t.run_consensus()
+    h, b, t = make_tpu_twin(build_consensus_graph)
     for x in h.consensus_events():
         he = h.store.get_event(x)
         te = t.store.get_event(x)
@@ -151,3 +158,21 @@ def test_gossip_tpu_engine():
         assert isinstance(node.core.hg, TpuHashgraph)
     run_gossip(nodes, target_round=5, timeout=120.0)
     check_gossip(nodes)
+
+
+def test_tpu_graph_get_frame_matches_host():
+    """GetFrame (the fast-sync snapshot, reference hashgraph.go:900-1002)
+    served from device-backed state must equal the host engine's frame:
+    same roots and the same events in the same (topological) order —
+    the order matters because frames are replayed in order during
+    fast-sync."""
+    h, b, t = make_tpu_twin(build_consensus_graph)
+
+    hf = h.get_frame()
+    tf = t.get_frame()
+    assert [e.hex() for e in tf.events] == [e.hex() for e in hf.events]
+    assert set(tf.roots) == set(hf.roots)
+    for pk, hr in hf.roots.items():
+        tr = tf.roots[pk]
+        assert (tr.x, tr.y, tr.index, tr.round, tr.others) == (
+            hr.x, hr.y, hr.index, hr.round, hr.others), pk
