@@ -1,4 +1,4 @@
-"""Multi-chip consensus: the full pipeline sharded over a 1-D device
+"""Multi-chip consensus: the full pipeline sharded over a device
 mesh — the layout SURVEY.md §5 prescribes (shard the event axis, all-
 gather coordinate rows for cross-shard stronglySee), applied to every
 stage of the real pipeline rather than a demo reduction:
@@ -26,12 +26,20 @@ Every stage reproduces the single-device kernels bit-for-bit (asserted
 by tests/test_sharded.py and the driver's dryrun_multichip). Semantics
 anchors are the same as ops/kernels.py: reference hashgraph.go:211-339,
 448-530, 616-858.
+
+`axis` may be a tuple of mesh axis names — e.g. ("dcn", "ici") on a
+hosts x chips mesh — in which case shards span both axes and every
+collective rides the combined axes (XLA routes the intra-host part
+over ICI and the cross-host part over DCN), the way the reference's
+TCP backend spans processes and hosts alike (net/tcp_transport.go).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Tuple, Union
+
+MeshAxis = Union[str, Tuple[str, ...]]
 
 import jax
 import jax.numpy as jnp
@@ -52,6 +60,14 @@ def _pad_axis(a: np.ndarray, axis: int, mult: int, fill) -> np.ndarray:
     return np.pad(a, widths, constant_values=fill)
 
 
+def _axis_size(mesh: Mesh, axis: MeshAxis) -> int:
+    names = (axis,) if isinstance(axis, str) else tuple(axis)
+    d = 1
+    for a in names:
+        d *= mesh.shape[a]
+    return d
+
+
 def _sharded(mesh, fn, in_specs, out_specs):
     return jax.jit(shard_map(
         fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
@@ -61,7 +77,7 @@ def _sharded(mesh, fn, in_specs, out_specs):
 # -- stage 1: lastAncestors, level slots sharded -------------------------
 
 
-def make_last_ancestors(mesh: Mesh, *, n: int, axis: str = "sp"):
+def make_last_ancestors(mesh: Mesh, *, n: int, axis: MeshAxis = "sp"):
     def la_sweep(self_parent, other_parent, creator, index, levels_loc):
         e = self_parent.shape[0] - 1
         w_loc = levels_loc.shape[1]
@@ -97,8 +113,8 @@ def make_last_ancestors(mesh: Mesh, *, n: int, axis: str = "sp"):
 # -- stage 2: first descendants, chains sharded --------------------------
 
 
-def make_first_descendants(mesh: Mesh, *, n: int, axis: str = "sp"):
-    d = mesh.devices.size
+def make_first_descendants(mesh: Mesh, *, n: int, axis: MeshAxis = "sp"):
+    d = _axis_size(mesh, axis)
     if n % d:
         raise ValueError(f"participants {n} must divide over {d} devices")
 
@@ -138,7 +154,7 @@ def make_first_descendants(mesh: Mesh, *, n: int, axis: str = "sp"):
 # -- stage 3: rounds + witness table, level slots sharded ----------------
 
 
-def make_rounds(mesh: Mesh, *, n: int, sm: int, r: int, axis: str = "sp"):
+def make_rounds(mesh: Mesh, *, n: int, sm: int, r: int, axis: MeshAxis = "sp"):
     def rounds_sweep(self_parent, other_parent, creator, index, la, fd,
                      levels_loc, root_round):
         e = la.shape[0]
@@ -196,8 +212,8 @@ def make_rounds(mesh: Mesh, *, n: int, sm: int, r: int, axis: str = "sp"):
 # -- stage 4: fame, voting witnesses sharded -----------------------------
 
 
-def make_fame(mesh: Mesh, *, n: int, sm: int, r: int, axis: str = "sp"):
-    d = mesh.devices.size
+def make_fame(mesh: Mesh, *, n: int, sm: int, r: int, axis: MeshAxis = "sp"):
+    d = _axis_size(mesh, axis)
     if n % d:
         raise ValueError(f"participants {n} must divide over {d} devices")
     n_loc = n // d
@@ -262,7 +278,7 @@ def make_fame(mesh: Mesh, *, n: int, sm: int, r: int, axis: str = "sp"):
 # -- stage 5: round received, pure event sharding ------------------------
 
 
-def make_round_received(mesh: Mesh, *, n: int, r: int, axis: str = "sp"):
+def make_round_received(mesh: Mesh, *, n: int, r: int, axis: MeshAxis = "sp"):
     def rr_block(rounds_loc, la_loc, fd_loc, creator_loc, index_loc,
                  wt, famous, idx_w, la_wt, chain_rank, valid_loc):
         e_loc = rounds_loc.shape[0]
@@ -316,11 +332,13 @@ def make_round_received(mesh: Mesh, *, n: int, r: int, axis: str = "sp"):
 # -- driver --------------------------------------------------------------
 
 
-def sharded_pipeline(dag, mesh: Mesh, axis: str = "sp") -> Tuple:
-    """Run the full consensus pipeline sharded over `mesh` (1-D). Output
-    contract matches pipeline.run_pipeline — and matches it bit-for-bit
-    (the parity oracle for the multi-chip path)."""
-    d = mesh.devices.size
+def sharded_pipeline(dag, mesh: Mesh, axis: MeshAxis = "sp") -> Tuple:
+    """Run the full consensus pipeline sharded over `mesh` along
+    `axis` (a mesh axis name or tuple of names for multi-host
+    hierarchies). Output contract matches pipeline.run_pipeline — and
+    matches it bit-for-bit (the parity oracle for the multi-chip
+    path)."""
+    d = _axis_size(mesh, axis)
     n, e, sm = dag.n, dag.e, dag.super_majority
     r = dag.max_rounds
 

@@ -16,15 +16,29 @@ from babble_tpu.ops.pipeline import run_pipeline
 from babble_tpu.ops.sharded import sharded_pipeline
 
 
-@pytest.mark.parametrize("n,e", [(8, 400), (16, 1000)], ids=["n8", "n16"])
-def test_sharded_matches_single_device(n, e):
+def _mesh(shape):
     devices = jax.devices()
     assert len(devices) >= 8, "conftest must provision the virtual mesh"
-    mesh = Mesh(np.array(devices[:8]), ("sp",))
+    if shape == "1d":
+        return Mesh(np.array(devices[:8]), ("sp",)), "sp"
+    # Hosts x chips: shards span both axes — the multi-host layout
+    # where XLA routes intra-host collective segments over ICI and
+    # cross-host segments over DCN (the reference's TCP backend spans
+    # hosts the same way).
+    return Mesh(np.array(devices[:8]).reshape(2, 4), ("dcn", "ici")), (
+        "dcn", "ici")
 
+
+@pytest.mark.parametrize(
+    "n,e,shape",
+    [(8, 400, "1d"), (16, 1000, "1d"), (8, 480, "2d")],
+    ids=["n8", "n16", "n8-dcn-ici"],
+)
+def test_sharded_matches_single_device(n, e, shape):
+    mesh, axis = _mesh(shape)
     dag, _ = synthetic_dag(n, e, seed=11)
     ref = [np.asarray(x) for x in run_pipeline(dag, engine="wavefront")]
-    got = [np.asarray(x) for x in sharded_pipeline(dag, mesh)]
+    got = [np.asarray(x) for x in sharded_pipeline(dag, mesh, axis=axis)]
 
     names = ["rounds", "witness", "witness_table", "famous",
              "round_received", "cts"]
