@@ -234,6 +234,16 @@ def decide_fame(wt, la, fd, index, coin, *, n, sm, r):
     famous0 = jnp.zeros((r, n), dtype=jnp.int32)
     votes0 = jnp.zeros((n, r, n), dtype=jnp.bool_)
 
+    # Opt-in pallas path for the pairwise strongly-see contraction (the
+    # per-round hot op at large n); the XLA broadcast-compare-reduce is
+    # the bit-identical default. The pallas module is only imported when
+    # the flag is set, so the default path never depends on it.
+    import os as _os
+
+    pallas_ss = _os.environ.get("BABBLE_PALLAS") == "1"
+    if pallas_ss:
+        from .pallas_kernels import strongly_see_counts_auto
+
     def step(j, carry):
         famous, v_prev = carry
         y = wt[j]
@@ -244,7 +254,11 @@ def decide_fame(wt, la, fd, index, coin, *, n, sm, r):
         wp = wt[j - 1]
         wp_valid = wp >= 0
         fd_p = fd[jnp.where(wp_valid, wp, 0)]  # [n, n]
-        ss = ((la_y[:, None, :] >= fd_p[None, :, :]).sum(-1) >= sm) & wp_valid[None, :]
+        if pallas_ss:
+            ss_cnt = strongly_see_counts_auto(la_y, fd_p)
+        else:
+            ss_cnt = (la_y[:, None, :] >= fd_p[None, :, :]).sum(-1)
+        ss = (ss_cnt >= sm) & wp_valid[None, :]
         # f32 contraction rides the MXU; tallies are <= n < 2^24 so
         # float32 arithmetic is exact.
         yays = (

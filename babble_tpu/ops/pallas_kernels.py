@@ -1,0 +1,94 @@
+"""Pallas TPU kernels for the consensus hot loops.
+
+The hottest dense primitive in the pipeline is the pairwise
+strongly-see count (reference hashgraph.go:179-198):
+
+    counts[x, w] = #{i : last_anc[x, i] >= first_desc[w, i]}
+
+— a "comparison matmul": contraction over the participant axis with >=
+instead of multiply. XLA fuses the broadcast-compare-reduce well, but
+the fused form materializes [M, W, n] tiles in registers at the
+compiler's discretion; this kernel makes the tiling explicit — [TM, TW]
+output tiles in VMEM with the participant axis accumulated in chunks —
+the way a matmul kernel would walk its K axis (guide:
+/opt/skills/guides/pallas_guide.md).
+
+Opt-in (BABBLE_PALLAS=1): the default paths keep the XLA formulation,
+which is bit-identical; kernels.decide_fame consults use_pallas() when
+tracing. On CPU backends the kernel runs in interpreter mode so tests
+exercise it without TPU hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+TILE = 128  # MXU/VPU-aligned output tile edge
+CHUNK = 128  # lane-aligned participant-axis step: one 8 MB compare cube in VMEM
+
+
+def use_pallas() -> bool:
+    """Opt-in switch, read at trace time."""
+    return os.environ.get("BABBLE_PALLAS") == "1"
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _ss_kernel(x_ref, y_ref, o_ref):
+    """Accumulate one participant-axis chunk into the [TILE, TILE]
+    output tile. The contraction axis is the innermost grid dimension,
+    so the tile is revisited consecutively (matmul K-walk): zero it on
+    the first visit, then add each chunk's compare-count."""
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    o_ref[:] += (
+        x_ref[:][:, None, :] >= y_ref[:][None, :, :]
+    ).sum(-1, dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def strongly_see_counts(la_x, fd_w, interpret: bool = False):
+    """counts[x, w] = sum_i (la_x[x, i] >= fd_w[w, i]) as a tiled
+    pallas kernel. la_x: [M, n] int32; fd_w: [W, n] int32; returns
+    [M, W] int32. Padding rows contribute nothing: the participant axis
+    is padded with la = INT32_MIN vs fd = INT32_MAX (never >=), and
+    padded output rows/columns are sliced off."""
+    m, n = la_x.shape
+    w = fd_w.shape[0]
+    m_pad, w_pad = _ceil_to(max(m, 1), TILE), _ceil_to(max(w, 1), TILE)
+    n_pad = _ceil_to(max(n, 1), CHUNK)
+
+    x = jnp.full((m_pad, n_pad), jnp.iinfo(jnp.int32).min, jnp.int32)
+    x = x.at[:m, :n].set(la_x)
+    y = jnp.full((w_pad, n_pad), jnp.iinfo(jnp.int32).max, jnp.int32)
+    y = y.at[:w, :n].set(fd_w)
+
+    out = pl.pallas_call(
+        _ss_kernel,
+        grid=(m_pad // TILE, w_pad // TILE, n_pad // CHUNK),
+        in_specs=[
+            pl.BlockSpec((TILE, CHUNK), lambda i, j, k: (i, k)),
+            pl.BlockSpec((TILE, CHUNK), lambda i, j, k: (j, k)),
+        ],
+        out_specs=pl.BlockSpec((TILE, TILE), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, w_pad), jnp.int32),
+        interpret=interpret,
+    )(x, y)
+    return out[:m, :w]
+
+
+def strongly_see_counts_auto(la_x, fd_w):
+    """Backend-appropriate dispatch: interpreter off-TPU (tests, CPU
+    meshes), compiled kernel on the chip."""
+    return strongly_see_counts(
+        la_x, fd_w, interpret=jax.default_backend() != "tpu")

@@ -1,0 +1,64 @@
+"""Pallas strongly-see kernel: bit parity with the XLA formulation
+(interpreter mode on the virtual CPU mesh), standalone and wired into
+decide_fame via BABBLE_PALLAS=1."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from babble_tpu.ops.pallas_kernels import strongly_see_counts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize(
+    "m,w,n", [(5, 7, 4), (64, 64, 64), (130, 200, 100)],
+    ids=["tiny", "square", "ragged"],
+)
+def test_strongly_see_counts_parity(m, w, n):
+    rng = np.random.default_rng(3)
+    la = rng.integers(-1, 50, (m, n)).astype(np.int32)
+    fd = rng.integers(0, 50, (w, n)).astype(np.int32)
+    fd[rng.random((w, n)) < 0.2] = np.iinfo(np.int32).max  # unreached
+    got = np.asarray(strongly_see_counts(la, fd, interpret=True))
+    want = (la[:, None, :] >= fd[None, :, :]).sum(-1, dtype=np.int32)
+    assert (got == want).all()
+
+
+@pytest.mark.slow
+def test_decide_fame_with_pallas_matches():
+    """decide_fame with BABBLE_PALLAS=1 (fresh process: the flag is read
+    at trace time) equals the default XLA path on a synthetic DAG."""
+    child = r"""
+import sys
+sys.path.insert(0, %(repo)r)
+from babble_tpu.devices import ensure_virtual_devices
+ensure_virtual_devices(1)
+import numpy as np
+from babble_tpu.ops.dag import synthetic_dag
+from babble_tpu.ops.pipeline import run_pipeline
+dag, _ = synthetic_dag(8, 400, seed=17)
+out = run_pipeline(dag, engine="wavefront")
+np.save("%(out)s", np.asarray(out[3]))
+"""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        results = {}
+        for flag in ("0", "1"):
+            path = os.path.join(td, f"famous{flag}.npy")
+            env = dict(os.environ)
+            env["BABBLE_PALLAS"] = flag
+            env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+            out = subprocess.run(
+                [sys.executable, "-c", child % {"repo": REPO, "out": path}],
+                capture_output=True, text=True, timeout=300, env=env,
+            )
+            assert out.returncode == 0, out.stderr[-2000:]
+            results[flag] = np.load(path)
+        assert (results["0"] == results["1"]).all()
