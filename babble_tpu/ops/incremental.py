@@ -81,6 +81,144 @@ def _closure_update(la, rb, self_parent, other_parent, creator, index,
     return lax.fori_loop(b0, b1, body, (la, rb))
 
 
+@functools.partial(jax.jit, static_argnames=("rows", "fill"),
+                   donate_argnums=(0,))
+def _pad_rows(a, *, rows, fill):
+    """Grow a device carry by `rows` fill-rows along axis 0 (donated)."""
+    pad_shape = (rows,) + a.shape[1:]
+    return jnp.concatenate([a, jnp.full(pad_shape, fill, a.dtype)], axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("cols", "fill"),
+                   donate_argnums=(0,))
+def _pad_cols(a, *, cols, fill):
+    """Grow a device carry by `cols` fill-columns along its last axis."""
+    pad_shape = a.shape[:-1] + (cols,)
+    return jnp.concatenate([a, jnp.full(pad_shape, fill, a.dtype)],
+                           axis=a.ndim - 1)
+
+
+@functools.partial(jax.jit, static_argnames=("cols",), donate_argnums=(0,))
+def _pad_ranks(ranks, len_counted, *, cols):
+    """Grow the fd rank cube [n, n, K] -> [n, n, K+cols]. Every counted
+    la value is a chain position < K <= t for the new thresholds t, so
+    the new columns are exactly the per-chain counted length."""
+    n = ranks.shape[0]
+    pad = jnp.broadcast_to(len_counted[:, None, None], (n, n, cols))
+    return jnp.concatenate([ranks, pad.astype(ranks.dtype)], axis=2)
+
+
+@functools.partial(jax.jit, static_argnames=("bp",),
+                   donate_argnums=(0, 1, 2, 3, 4, 5))
+def _ingest(sp_d, op_d, cr_d, idx_d, coin_d, rb0_d,
+            sp_b, op_b, cr_b, idx_b, coin_b, rb0_b, e0, *, bp):
+    """Write one appended batch (host slices padded to bp) into the
+    device-resident event arrays at offset e0. Pad lanes carry the init
+    fill values, so rows beyond the true batch stay inert until a later
+    batch overwrites them."""
+    out = []
+    for arr, b in ((sp_d, sp_b), (op_d, op_b), (cr_d, cr_b),
+                   (idx_d, idx_b), (coin_d, coin_b), (rb0_d, rb0_b)):
+        out.append(lax.dynamic_update_slice(arr, b.astype(arr.dtype), (e0,)))
+    return tuple(out)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "m"), donate_argnums=(0,))
+def _chain_ingest(chain_d, newtab, newpos, *, n, m):
+    """Scatter the batch's per-creator new events ([n, m] id table, -1
+    pad; newpos the matching chain positions) into the resident chain
+    table. Pad lanes scatter out of bounds and are dropped."""
+    k = chain_d.shape[1]
+    valid = newtab >= 0
+    pos = jnp.where(valid, newpos, k)  # OOB -> dropped
+    crows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, m))
+    return chain_d.at[crows, pos].set(newtab, mode="drop")
+
+
+# Working-set bound for the incremental fd-rank update's
+# [n, m, n, tc] compare cube.
+_FD_CHUNK_ELEMS = 1 << 25
+
+
+@functools.partial(jax.jit, static_argnames=("n", "m"),
+                   donate_argnums=(0, 1, 2))
+def _tables_update(ranks, chain_la, chain_rb, la, rb, newtab, newpos,
+                   *, n, m):
+    """Fold one appended batch into the resident per-chain tables:
+
+    chain_la[c, k, i] / chain_rb[c, k]  new rows written from the
+        batch events' (frozen) coordinates;
+    ranks[c, i, t] += #{new events on chain c : la[., i] < t}  — the
+        incremental form of kernels.first_descendant_cube's
+        compare-and-count: old events' la rows never change, so the
+        count over a chain only grows by the new suffix contributions
+        (reference semantics hashgraph.go:490-530). Per-sync cost is
+        O(batch * n * K) instead of the full cube's O(n^2 * K^2).
+    """
+    k = ranks.shape[2]
+    cap1 = la.shape[0]
+    valid = newtab >= 0
+    ids = jnp.where(valid, newtab, cap1 - 1)  # sentinel row, masked below
+    la_new = la[ids]  # [n, m, n]
+    rb_new = rb[ids]  # [n, m]
+    pos = jnp.where(valid, newpos, k)  # OOB -> dropped
+    crows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, m))
+    chain_la = chain_la.at[crows, pos].set(
+        jnp.where(valid[:, :, None], la_new, INT32_MAX), mode="drop")
+    chain_rb = chain_rb.at[crows, pos].set(
+        jnp.where(valid, rb_new, INT32_MAX), mode="drop")
+
+    tc = max(min(_FD_CHUNK_ELEMS // max(n * m * n, 1), k), 1)
+    while k % tc:
+        tc -= 1
+    nchunks = k // tc
+
+    def chunk(g, ranks):
+        t0 = g * tc
+        ts = t0 + jnp.arange(tc, dtype=jnp.int32)
+        cmp = valid[:, :, None, None] & (
+            la_new[:, :, :, None] < ts[None, None, None, :])
+        delta = cmp.sum(1, dtype=jnp.int32)  # [n, n, tc]
+        blk = lax.dynamic_slice(ranks, (0, 0, t0), (n, n, tc)) + delta
+        return lax.dynamic_update_slice(ranks, blk, (0, 0, t0))
+
+    ranks = lax.fori_loop(0, nchunks, chunk, ranks)
+    return ranks, chain_la, chain_rb
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _fd_from_ranks(ranks, chain_len, creator, index, *, n):
+    """fd[a, c] from the resident rank cube: event a = chain[creator_a,
+    index_a], so fd[a, c] = ranks[c, creator_a, index_a], INT32_MAX when
+    the position is past chain c's end (same contract as
+    kernels.fd_from_cube, with the chain_len clamp fused into the
+    gather instead of materializing the clamped cube)."""
+    k = ranks.shape[2]
+    e1 = creator.shape[0] - 1
+    ca = creator[:e1]
+    ia = jnp.clip(index[:e1], 0, k - 1)
+    raw = ranks[:, ca, ia].T  # [cap, n]
+    fd = jnp.where(raw < chain_len[None, :], raw, INT32_MAX)
+    return jnp.where((index[:e1] >= 0)[:, None], fd, INT32_MAX)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "sm", "rcap"))
+def _frontier_packed(chain_la, chain_rbase, chain_len, la, fd, rb, chain,
+                     wt_tab, fr_tab, wt_prev, fr_prev, t0, rho_min,
+                     *, n, sm, rcap):
+    """frontier.frontier_sweep plus result packing: one flat int32
+    buffer [1 + 2*rcap*n] = (t_end, wt_tab, fr_tab) so the host costs a
+    single device->host round trip instead of three (the tunneled
+    runtime charges per sync, not per byte)."""
+    wt_tab, fr_tab, t_end = frontier.frontier_sweep(
+        chain_la, chain_rbase, chain_len, la, fd, rb, chain,
+        wt_tab, fr_tab, wt_prev, fr_prev, t0, rho_min,
+        n=n, sm=sm, rcap=rcap)
+    packed = jnp.concatenate(
+        [t_end[None].astype(jnp.int32), wt_tab.ravel(), fr_tab.ravel()])
+    return packed
+
+
 @functools.partial(jax.jit, static_argnames=("n", "sm", "rw", "iw"))
 def _fused_fame_rr(wt_win, famous_prev_win, in_list_win, wt_rr, fam_low_rr,
                    elig_low, rounds, rr_prev, la, fd, creator, index, coin,
@@ -97,8 +235,9 @@ def _fused_fame_rr(wt_win, famous_prev_win, in_list_win, wt_rr, fam_low_rr,
     merged fame and a device-derived eligibility: round fully decided
     AND below the post-merge first undecided round
     (hashgraph.go:762-764). rr assignments are final; `rr_prev` keeps
-    them. Returns (famous_merged, rr, cts_rank) with cts only for
-    newly-assigned events."""
+    them. Returns one packed int32 buffer [rw*n + 2*E] =
+    (famous_merged, rr, cts_rank) — cts only for newly-assigned events —
+    so the host pays a single device->host round trip."""
     e = rounds.shape[0]
     k = chain_rank.shape[1]
 
@@ -159,7 +298,7 @@ def _fused_fame_rr(wt_win, famous_prev_win, in_list_win, wt_rr, fam_low_rr,
     sorted_t = jnp.sort(tvals, axis=1)
     med = jnp.take_along_axis(sorted_t, (s_cnt // 2)[:, None], axis=1)[:, 0]
     cts = jnp.where(newly, med, ZERO_TS_RANK)
-    return famous_merged, rr, cts
+    return jnp.concatenate([famous_merged.ravel(), rr, cts])
 
 
 @dataclass
@@ -190,15 +329,6 @@ class IncrementalEngine:
                  block: int = 256, k_capacity: int = 64):
         if n < 1:
             raise ValueError("need at least one participant")
-        if n > 256 and jax.default_backend() == "tpu":
-            import logging
-
-            logging.getLogger("babble_tpu").warning(
-                "IncrementalEngine at n=%d on TPU: the frontier sweep is "
-                "known to kernel-fault at n=1024 on the tunneled axon "
-                "runtime (ops/frontier.py); one-shot consensus via "
-                "run_pipeline(engine='wavefront') is the validated path "
-                "at this scale", n)
         self.n = n
         self.sm = 2 * n // 3 + 1
         self.block = block
@@ -228,10 +358,26 @@ class IncrementalEngine:
         self.rr = np.zeros(self.cap, np.int32)  # pad rows 0: never assigned
         self.cts_ns = np.zeros(self.cap, np.int64)
 
-        # Device carries.
+        # Device carries. Coordinates plus everything the per-sync
+        # pipeline would otherwise re-upload or recompute from scratch:
+        # the event arrays (ingested by batch slice), the chain tables
+        # (new rows only), and the fd rank cube (incremental
+        # compare-and-count; see _tables_update).
         self._la = jnp.full((c1, n), -1, jnp.int32)
         self._rb = jnp.full((c1,), -1, jnp.int32)
         self._frozen_blocks = 0
+        self._sp_d = jnp.full((c1,), -1, jnp.int32)
+        self._op_d = jnp.full((c1,), -1, jnp.int32)
+        self._cr_d = jnp.zeros((c1,), jnp.int32)
+        self._idx_d = jnp.full((c1,), -1, jnp.int32)
+        self._coin_d = jnp.zeros((c1,), jnp.int8)
+        self._rb0_d = jnp.full((c1,), -1, jnp.int32)
+        self._chain_d = jnp.full((n, self.kcap), -1, jnp.int32)
+        self._ranks = jnp.zeros((n, n, self.kcap), jnp.int32)
+        self._chain_la = jnp.full((n, self.kcap, n), INT32_MAX, jnp.int32)
+        self._chain_rb = jnp.full((n, self.kcap), INT32_MAX, jnp.int32)
+        self._e_counted = 0
+        self._len_counted = np.zeros(n, np.int32)
 
         # Frontier checkpoint: relative rows rho_min + t.
         self._fr_table = np.zeros((0, n), np.int32)
@@ -324,12 +470,9 @@ class IncrementalEngine:
             out = np.full(new_cap, fill, dtype)
             out[: self.cap] = getattr(self, name)[: self.cap]
             setattr(self, name, out)
-        la = np.full((c1, self.n), -1, np.int32)
-        la[: self.cap] = np.asarray(self._la[: self.cap])
-        rb = np.full(c1, -1, np.int32)
-        rb[: self.cap] = np.asarray(self._rb[: self.cap])
-        self._la = jnp.asarray(la)
-        self._rb = jnp.asarray(rb)
+        # Device carries grow lazily at the next run() (_sync_device):
+        # appends touch only host mirrors, so growth never costs a
+        # device round trip here.
         self.cap = new_cap
 
     def _grow_chains(self) -> None:
@@ -340,6 +483,90 @@ class IncrementalEngine:
         self.kcap = new_k
 
     # -- the incremental pipeline -----------------------------------------
+
+    @property
+    def _cap_dev(self) -> int:
+        """Device-side event capacity, derived from the carry shapes so
+        it can never desynchronize from the buffers it describes."""
+        return self._la.shape[0] - 1
+
+    @property
+    def _kcap_dev(self) -> int:
+        return self._chain_d.shape[1]
+
+    def _sync_device(self) -> None:
+        """Bring the device carries up to the host mirrors' capacity and
+        chain-bucket sizes (appends grow host state only). All growth is
+        device-side concatenation — no device->host round trips."""
+        n = self.n
+        while self._cap_dev < self.cap:
+            rows = self._cap_dev  # double
+            self._la = _pad_rows(self._la, rows=rows, fill=-1)
+            self._rb = _pad_rows(self._rb, rows=rows, fill=-1)
+            self._sp_d = _pad_rows(self._sp_d, rows=rows, fill=-1)
+            self._op_d = _pad_rows(self._op_d, rows=rows, fill=-1)
+            self._cr_d = _pad_rows(self._cr_d, rows=rows, fill=0)
+            self._idx_d = _pad_rows(self._idx_d, rows=rows, fill=-1)
+            self._coin_d = _pad_rows(self._coin_d, rows=rows, fill=0)
+            self._rb0_d = _pad_rows(self._rb0_d, rows=rows, fill=-1)
+        while self._kcap_dev < self.kcap:
+            cols = self._kcap_dev  # double
+            self._ranks = _pad_ranks(
+                self._ranks, jnp.asarray(self._len_counted), cols=cols)
+            self._chain_la = jnp.concatenate(
+                [self._chain_la,
+                 jnp.full((n, cols, n), INT32_MAX, jnp.int32)], axis=1)
+            self._chain_d = _pad_cols(self._chain_d, cols=cols, fill=-1)
+            self._chain_rb = _pad_cols(self._chain_rb, cols=cols,
+                                       fill=INT32_MAX)
+
+    def _ingest_batch(self):
+        """Stage the events appended since the last run into the device
+        carries: event-array slices at [e0, e), the per-creator new-event
+        table into chain/coordinate tables, and the fd rank cube."""
+        n = self.n
+        e0, e = self._e_counted, self.e
+        if e0 == e:
+            return
+        b = e - e0
+        bp = _pow2(b)
+        while e0 + bp > self._cap_dev + 1 and bp > b:
+            bp //= 2
+        if bp < b:
+            bp = b  # rare near-capacity tail; exact-size compile
+
+        def slc(a, fill, dtype):
+            out = np.full(bp, fill, dtype)
+            out[:b] = a[e0:e]
+            return jnp.asarray(out)
+
+        self._sp_d, self._op_d, self._cr_d, self._idx_d, self._coin_d, \
+            self._rb0_d = _ingest(
+                self._sp_d, self._op_d, self._cr_d, self._idx_d,
+                self._coin_d, self._rb0_d,
+                slc(self.self_parent, -1, np.int32),
+                slc(self.other_parent, -1, np.int32),
+                slc(self.creator, 0, np.int32),
+                slc(self.index, -1, np.int32),
+                slc(self.coin, 0, np.int8),
+                slc(self.root_base, -1, np.int32),
+                jnp.int32(e0), bp=bp)
+
+        # Per-creator new-event table: each creator's new events are the
+        # suffix of its chain added since the last fold.
+        new_lens = self.chain_len - self._len_counted
+        m = _pow2(int(new_lens.max()), 1)
+        newtab = np.full((n, m), -1, np.int32)
+        newpos = np.zeros((n, m), np.int32)
+        for c in np.nonzero(new_lens)[0]:
+            l0, l1 = int(self._len_counted[c]), int(self.chain_len[c])
+            newtab[c, : l1 - l0] = self.chain[c, l0:l1]
+            newpos[c, : l1 - l0] = np.arange(l0, l1)
+        self._newtab_d = jnp.asarray(newtab)
+        self._newpos_d = jnp.asarray(newpos)
+        self._new_m = m
+        self._chain_d = _chain_ingest(
+            self._chain_d, self._newtab_d, self._newpos_d, n=n, m=m)
 
     def run(self) -> RunDelta:
         if self.e == 0 or (self._empty_delta_ok and not self._new_since_run):
@@ -369,30 +596,38 @@ class IncrementalEngine:
             self.phase_ns[name] = now - _phase_start
             _phase_start = now
 
-        sp_d = jnp.asarray(self.self_parent)
-        op_d = jnp.asarray(self.other_parent)
-        cr_d = jnp.asarray(self.creator)
-        idx_d = jnp.asarray(self.index)
-        coin_d = jnp.asarray(self.coin)
-        rb0_d = jnp.asarray(self.root_base)
-        chain_d = jnp.asarray(self.chain)
+        # 0. Device sync-up: lazy capacity growth, then ingest the new
+        # batch into the resident event arrays and chain table. All
+        # dispatches are async — nothing here round-trips.
+        self._sync_device()
+        self._ingest_batch()
         chain_len_d = jnp.asarray(self.chain_len)
+        cr_d = self._cr_d
+        idx_d = self._idx_d
+        coin_d = self._coin_d
 
         # 1. Coordinates: only blocks the frozen prefix doesn't cover.
         nb = (e + self.block - 1) // self.block
         self._la, self._rb = _closure_update(
-            self._la, self._rb, sp_d, op_d, cr_d, idx_d, rb0_d,
-            jnp.int32(self._frozen_blocks), jnp.int32(nb),
+            self._la, self._rb, self._sp_d, self._op_d, cr_d, idx_d,
+            self._rb0_d, jnp.int32(self._frozen_blocks), jnp.int32(nb),
             n=n, block=self.block)
         self._frozen_blocks = e // self.block
         la = self._la[: self.cap]
         rb = self._rb[: self.cap]
         _mark("coords", la)
 
-        # 2. First descendants (closed form, full recompute: old events'
-        # entries legitimately change when descendants arrive).
-        fd = kernels.compute_first_descendants(
-            la, cr_d, idx_d, chain_d, chain_len_d, n=n)
+        # 2. First descendants from the resident rank cube, folding the
+        # batch first (incremental compare-and-count — per-sync cost
+        # scales with the batch, not E; see _tables_update).
+        if self._e_counted < e:
+            self._ranks, self._chain_la, self._chain_rb = _tables_update(
+                self._ranks, self._chain_la, self._chain_rb,
+                self._la, self._rb, self._newtab_d, self._newpos_d,
+                n=n, m=self._new_m)
+            self._e_counted = e
+            self._len_counted = self.chain_len.copy()
+        fd = _fd_from_ranks(self._ranks, chain_len_d, cr_d, idx_d, n=n)
         _mark("fd", fd)
 
         # 3. Witness frontier, warm-started at the first growable row.
@@ -404,34 +639,33 @@ class IncrementalEngine:
             t0 = int(np.argmax(growable)) if growable.any() else rel_rows
         else:
             t0 = 0
-        chain_la, chain_rbase = frontier.build_chain_tables(
-            la, rb, chain_d, n=n)
         if t0 > 0:
             wt_prev = jnp.asarray(self._wt_table[t0 - 1])
             fr_prev = jnp.asarray(self._fr_table[t0 - 1])
         else:
             wt_prev = jnp.full((n,), -1, jnp.int32)
             fr_prev = jnp.zeros((n,), jnp.int32)
-        # Single-dispatch device sweep: one host sync (t_end) per run,
-        # instead of one per rc-round chunk — the tunnel round-trip is
-        # the cost that matters, not the round count.
+        # Single-dispatch device sweep with packed results: ONE
+        # device->host pull (t_end + both tables) per attempt — the
+        # tunnel round-trip is the cost that matters, not the bytes.
         rcap = _pow2(rel_rows + 8, 16)
         while True:
             wt_tab = np.full((rcap, n), -1, np.int32)
             fr_tab = np.full((rcap, n), self.kcap, np.int32)
             wt_tab[:t0] = self._wt_table[:t0]
             fr_tab[:t0] = self._fr_table[:t0]
-            wt_tab_d, fr_tab_d, t_end = frontier.frontier_sweep(
-                chain_la, chain_rbase, chain_len_d, la, fd, rb, chain_d,
-                jnp.asarray(wt_tab), jnp.asarray(fr_tab), wt_prev,
-                fr_prev, jnp.int32(t0), jnp.int32(self.rho_min), n=n, sm=sm,
-                rcap=rcap)
-            t_end = int(t_end)
+            packed = np.asarray(_frontier_packed(
+                self._chain_la, self._chain_rb, chain_len_d, la, fd, rb,
+                self._chain_d, jnp.asarray(wt_tab), jnp.asarray(fr_tab),
+                wt_prev, fr_prev, jnp.int32(t0), jnp.int32(self.rho_min),
+                n=n, sm=sm, rcap=rcap))
+            t_end = int(packed[0])
             if t_end < rcap:
                 break
             rcap *= 2
-        fr_all = np.asarray(fr_tab_d)[:t_end]
-        wt_all = np.asarray(wt_tab_d)[:t_end]
+        tabs = packed[1:].reshape(2, rcap, n)
+        wt_all = tabs[0, :t_end]
+        fr_all = tabs[1, :t_end]
         active = (fr_all < self.chain_len[None, :]).any(axis=1)
         n_rows = int(np.nonzero(active)[0][-1]) + 1 if active.any() else 0
         self._fr_table = fr_all[:n_rows]
@@ -514,17 +748,17 @@ class IncrementalEngine:
             ranks = inv.astype(np.int32)
             chain_rank[valid] = ranks[safe[valid]]
 
-            famous_merged_d, rr_new, cts_rank = _fused_fame_rr(
+            packed_f = np.asarray(_fused_fame_rr(
                 jnp.asarray(wt_win), jnp.asarray(fam_prev_win),
                 jnp.asarray(in_list_win), jnp.asarray(wt_rr),
                 jnp.asarray(fam_low_rr), jnp.asarray(elig_low),
                 jnp.asarray(self.rounds[: self.cap]),
                 jnp.asarray(self.rr[: self.cap]),
                 la, fd, cr_d, idx_d, coin_d, jnp.asarray(chain_rank),
-                jnp.int32(rx0), jnp.int32(i0), n=n, sm=sm, rw=rw, iw=iw)
-            famous_merged = np.asarray(famous_merged_d)
-            rr_np = np.asarray(rr_new)
-            cts_np = np.asarray(cts_rank)
+                jnp.int32(rx0), jnp.int32(i0), n=n, sm=sm, rw=rw, iw=iw))
+            famous_merged = packed_f[: rw * n].reshape(rw, n)
+            rr_np = packed_f[rw * n: rw * n + self.cap]
+            cts_np = packed_f[rw * n + self.cap:]
 
             # Host mirror of DecideFame's bookkeeping from the pulled
             # fame window (hashgraph.go:649-730).
