@@ -89,13 +89,14 @@ def _pad_rows(a, *, rows, fill):
     return jnp.concatenate([a, jnp.full(pad_shape, fill, a.dtype)], axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=("cols", "fill"),
+@functools.partial(jax.jit, static_argnames=("cols", "fill", "axis"),
                    donate_argnums=(0,))
-def _pad_cols(a, *, cols, fill):
-    """Grow a device carry by `cols` fill-columns along its last axis."""
-    pad_shape = a.shape[:-1] + (cols,)
+def _pad_cols(a, *, cols, fill, axis=-1):
+    """Grow a device carry by `cols` fill-slices along `axis` (donated)."""
+    axis = axis % a.ndim
+    pad_shape = a.shape[:axis] + (cols,) + a.shape[axis + 1:]
     return jnp.concatenate([a, jnp.full(pad_shape, fill, a.dtype)],
-                           axis=a.ndim - 1)
+                           axis=axis)
 
 
 @functools.partial(jax.jit, static_argnames=("cols",), donate_argnums=(0,))
@@ -374,6 +375,12 @@ class IncrementalEngine:
         self._rb0_d = jnp.full((c1,), -1, jnp.int32)
         self._chain_d = jnp.full((n, self.kcap), -1, jnp.int32)
         self._ranks = jnp.zeros((n, n, self.kcap), jnp.int32)
+        # chain_la/chain_rb could be re-gathered per run from la/chain
+        # (build_chain_tables), but the gather materializes this same
+        # [n, K, n] cube transiently anyway (the frontier consumes it),
+        # and at n=1024 it would re-read ~2 GB of HBM per sync; keeping
+        # it resident costs the same peak memory and only writes the
+        # new chain suffix rows.
         self._chain_la = jnp.full((n, self.kcap, n), INT32_MAX, jnp.int32)
         self._chain_rb = jnp.full((n, self.kcap), INT32_MAX, jnp.int32)
         self._e_counted = 0
@@ -513,9 +520,8 @@ class IncrementalEngine:
             cols = self._kcap_dev  # double
             self._ranks = _pad_ranks(
                 self._ranks, jnp.asarray(self._len_counted), cols=cols)
-            self._chain_la = jnp.concatenate(
-                [self._chain_la,
-                 jnp.full((n, cols, n), INT32_MAX, jnp.int32)], axis=1)
+            self._chain_la = _pad_cols(self._chain_la, cols=cols,
+                                       fill=INT32_MAX, axis=1)
             self._chain_d = _pad_cols(self._chain_d, cols=cols, fill=-1)
             self._chain_rb = _pad_cols(self._chain_rb, cols=cols,
                                        fill=INT32_MAX)

@@ -273,3 +273,37 @@ def build_funky_graph() -> Tuple[Hashgraph, GraphBuilder]:
     for ev in b.ordered_events:
         h.insert_event(ev, True)
     return h, b
+
+
+def build_coin_graph(extra_rounds: int = 3) -> GraphBuilder:
+    """The funky graph extended with a gossip ring so the coin round
+    RESOLVES: w00's fame cannot be decided by round 4 (the normal
+    rounds stay split), so round-4 witnesses cast coin votes
+    (diff % n == 0, reference hashgraph.go:703-709), and the round-5
+    tally decides from those coin-influenced votes. With the coin
+    forced to 1 the graph decides w00 famous; forced to 0 it stays
+    undecided forever (the hashgraph coin-round liveness hole) — both
+    outcomes are topology-deterministic, which is what makes this
+    testable even though real coin bits depend on event signatures.
+
+    Returns the builder only (no consensus run): callers choose the
+    engine and the coin regime."""
+    b = GraphBuilder(4)
+    for i in range(4):
+        b.add_initial(f"w0{i}", i, [f"w0{i}".encode()])
+    heads = {0: "w00", 1: "w01", 2: "w02", 3: "w03"}
+    idx = {0: 0, 1: 0, 2: 0, 3: 0}
+    for p in FUNKY_PLAYS:
+        b.play(p)
+        heads[p.to] = p.name
+        idx[p.to] = p.index
+    k = 0
+    for _ in range(extra_rounds):
+        for c, p in ((3, 1), (1, 3), (0, 2), (2, 0)):
+            idx[c] += 1
+            name = f"z{k}"
+            b.play(Play(c, idx[c], heads[c], heads[p], name,
+                        [name.encode()]))
+            heads[c] = name
+            k += 1
+    return b

@@ -69,8 +69,10 @@ while k < e:
                      dag.creator[k:hi], dag.index[k:hi],
                      dag.coin[k:hi], np.arange(k, hi))
     eng.run()
-    # pull values: axon kernel faults only surface at device->host copy
-    _ = int(eng.rounds[:hi].max())
+    # force a real device->host transfer: axon kernel faults only
+    # surface at the copy (run() itself pulls, but an engine carry pull
+    # double-checks the closure path the packed results don't cover)
+    _ = np.asarray(eng._la[0])
     k = hi
 rounds, wit, wt, famous, rr, cts = map(np.asarray,
                                        run_pipeline(dag, engine="closure"))
