@@ -1,8 +1,12 @@
 # Build/CI entry points — reference makefile:24-25 (`make test`) plus
-# the bench and demo-testnet drivers.
+# the bench and demo-testnet drivers, and `make dist` as the
+# counterpart of the reference's release build (scripts/dist.sh).
 PY ?= python
 
-.PHONY: test test-fast bench demo conf run bombard watch stop
+.PHONY: test test-fast bench demo conf run bombard watch stop dist
+
+dist:
+	$(PY) -m build
 
 test:
 	$(PY) -m pytest tests/ -q

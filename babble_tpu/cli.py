@@ -80,6 +80,10 @@ def cmd_run(args) -> int:
         store_type=args.store,
         store_path=args.store_path or os.path.join(datadir, "store.db"),
         engine=args.engine,
+        consensus_interval=(
+            args.consensus_interval / 1000.0
+            if args.consensus_interval is not None
+            else (0.05 if args.engine == "tpu" else 0.0)),
         logger=logger,
     )
 
@@ -164,6 +168,11 @@ def build_parser() -> argparse.ArgumentParser:
     rn.add_argument("--engine", default="host", choices=["host", "tpu"],
                     help="consensus engine: reference-semantics host "
                          "driver or the batched device pipeline")
+    rn.add_argument("--consensus_interval", type=int, default=None,
+                    help="min milliseconds between consensus passes "
+                         "(0 = after every sync, the reference cadence; "
+                         "default 0 for --engine host, 50 for tpu so "
+                         "several syncs share one device pass)")
     rn.set_defaults(fn=cmd_run)
 
     vs = sub.add_parser("version", help="print version")

@@ -23,6 +23,14 @@ class Config:
     # Consensus engine: "host" (incremental reference-semantics Python)
     # or "tpu" (batched device pipeline behind the same seam).
     engine: str = "host"
+    # Minimum seconds between consensus passes. 0 = reference behavior
+    # (RunConsensus after every sync, node/node.go:467-487). With the
+    # device engine each pass costs a device round trip and holds the
+    # core lock, so batching several syncs per pass keeps gossip at
+    # wire speed while consensus drains the backlog in device-sized
+    # batches — ordering is unaffected (consensus is deterministic in
+    # the DAG, not in when it runs), only commit latency trades off.
+    consensus_interval: float = 0.0
     logger: logging.Logger = field(default_factory=_default_logger)
 
 

@@ -105,6 +105,8 @@ class Node:
         self.control_timer.run()
         self._start_forwarders()
         self.state.go_func(self._do_background_work)
+        if self.conf.consensus_interval > 0:
+            self.state.go_func(self._consensus_loop)
 
         while True:
             state = self.state.get_state()
@@ -191,6 +193,22 @@ class Node:
             if self.state.get_state() != old_state:
                 return
 
+    def _consensus_loop(self) -> None:
+        """Dedicated consensus worker (consensus_interval > 0): a pass
+        every interval, off the gossip path, so syncs never block on
+        the (device) pipeline — they only contend for the core lock
+        while a pass is applying its results."""
+        iv = self.conf.consensus_interval
+        while not self._shutdown.is_set():
+            self._shutdown.wait(iv)
+            if self._shutdown.is_set():
+                return
+            try:
+                with self.core_lock:
+                    self.core.run_consensus()
+            except Exception as exc:  # noqa: BLE001 - keep the loop alive
+                self.logger.error("consensus pass failed: %s", exc)
+
     def _pre_gossip(self) -> bool:
         with self.core_lock:
             need = self.core.need_gossip() or self.state.is_starting()
@@ -271,9 +289,13 @@ class Node:
 
     def _sync(self, events) -> None:
         """Insert synced events + run consensus (caller holds core_lock)
-        — reference node/node.go:467-487."""
+        — reference node/node.go:467-487. With consensus_interval > 0
+        the pass moves to the dedicated consensus worker: syncs are
+        pure wire-speed inserts and the engine drains several syncs per
+        (device) pass."""
         self.core.sync(events)
-        self.core.run_consensus()
+        if self.conf.consensus_interval <= 0:
+            self.core.run_consensus()
 
     def _fast_forward(self) -> None:
         # Reference stub (node/node.go:432-441): fast-sync from a Frame

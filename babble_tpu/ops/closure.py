@@ -29,8 +29,9 @@ from jax import lax
 
 # Fusion working-set bound for the closure-apply reduction: rows are
 # processed in chunks so each fused [rows, B, n] select+max stays under
-# ~16M elements.
-_APPLY_ELEMS = 1 << 24
+# ~64M elements (raised with the other chunk bounds: fewer, fatter
+# kernels win on the tunneled runtime).
+_APPLY_ELEMS = 1 << 26
 
 
 def _apply_chunks(block: int, n: int) -> int:

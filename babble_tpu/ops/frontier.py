@@ -48,9 +48,11 @@ from .kernels import INT32_MAX
 
 # Working-set bound for the per-round [chains, coords, witnesses]
 # searchsorted cube: chains are processed in chunks so each materialized
-# [cc, n, n] block stays under ~16M elements (the full cube would be
-# 4.3 GB at n=1024).
-_CUBE_ELEMS = 1 << 24
+# [cc, n, n] block stays under ~64M elements (sized to trade kernel
+# count for VMEM pressure — on the tunneled runtime sequential tiny
+# kernels, not FLOPs, bound the sweep; the full cube would be 4.3 GB at
+# n=1024).
+_CUBE_ELEMS = 1 << 26
 
 
 def _chain_chunks(n: int) -> int:

@@ -137,8 +137,10 @@ def _chain_ingest(chain_d, newtab, newpos, *, n, m):
 
 
 # Working-set bound for the incremental fd-rank update's
-# [n, m, n, tc] compare cube.
-_FD_CHUNK_ELEMS = 1 << 25
+# [n, m, n, tc] compare cube (sized to trade kernel count for VMEM
+# pressure: on the tunneled runtime sequential tiny kernels, not FLOPs,
+# bound the sync).
+_FD_CHUNK_ELEMS = 1 << 26
 
 
 @functools.partial(jax.jit, static_argnames=("n", "m"),
@@ -203,44 +205,71 @@ def _fd_from_ranks(ranks, chain_len, creator, index, *, n):
     return jnp.where((index[:e1] >= 0)[:, None], fd, INT32_MAX)
 
 
-@functools.partial(jax.jit, static_argnames=("n", "sm", "rcap"))
-def _frontier_packed(chain_la, chain_rbase, chain_len, la, fd, rb, chain,
-                     wt_tab, fr_tab, wt_prev, fr_prev, t0, rho_min,
-                     *, n, sm, rcap):
-    """frontier.frontier_sweep plus result packing: one flat int32
-    buffer [1 + 2*rcap*n] = (t_end, wt_tab, fr_tab) so the host costs a
-    single device->host round trip instead of three (the tunneled
-    runtime charges per sync, not per byte)."""
+@functools.partial(
+    jax.jit, static_argnames=("n", "sm", "rcap", "bp", "rw", "iw", "cb"))
+def _consensus_fused(chain_la, chain_rb_tab, chain_len, la, fd, rb_vec,
+                     chain, wt_tab, fr_tab, wt_prev, fr_prev, t0, rho_min,
+                     self_parent, creator, index, coin, e0, e1,
+                     rounds_host, rr_prev, fam_rel, in_list_rel,
+                     chain_rank, rx0, first_undec_prev,
+                     *, n, sm, rcap, bp, rw, iw, cb):
+    """The whole per-sync consensus tail in one dispatch — frontier
+    sweep, new-event rounds, fame merge, round-received — returning a
+    single packed int32 buffer so the host pays exactly ONE
+    device->host round trip per sync (the tunneled runtime charges per
+    sync, not per byte; see also _fused_fame_rr's semantics which this
+    kernel absorbs).
+
+    Window geometry: the witness/frontier tables are rho_min-relative
+    [rcap, n]; fame runs over the window [rx0, rho_min + rcap) and
+    round-received over [i0, rho_min + rcap), where i0 is derived ON
+    DEVICE from the new batch's rounds (i0 = min(first_undec_prev,
+    min_new_round + 1)) — the host no longer needs an intermediate pull
+    to build the windows. Host bookkeeping inputs (`fam_rel`,
+    `in_list_rel`) are rho_min-relative round tables built
+    from the PREVIOUS run's state; rows at or beyond this run's fame
+    window take device-merged values exactly as the reference's
+    DecideFame/DecideRoundReceived interleave (hashgraph.go:649-799).
+
+    Packed layout: [t_end, wt_tab(rcap*n), fr_tab(rcap*n),
+    new_rounds(bp), new_wit(bp), famous_merged(rcap*n), rr(E), cts(E)].
+    """
+    e = rounds_host.shape[0]
+    k = chain_rank.shape[1]
+
+    # 1. Witness frontier.
     wt_tab, fr_tab, t_end = frontier.frontier_sweep(
-        chain_la, chain_rbase, chain_len, la, fd, rb, chain,
+        chain_la, chain_rb_tab, chain_len, la, fd, rb_vec, chain,
         wt_tab, fr_tab, wt_prev, fr_prev, t0, rho_min,
         n=n, sm=sm, rcap=rcap)
-    packed = jnp.concatenate(
-        [t_end[None].astype(jnp.int32), wt_tab.ravel(), fr_tab.ravel()])
-    return packed
 
+    # 2. Rounds + witness flags for the batch [e0, e1): round = rho_min
+    # - 1 + #{frontier rows at or below the event's chain position}
+    # (rows >= t_end keep the upload's kcap fill and never count).
+    ids_b = e0 + jnp.arange(bp)
+    valid_b = ids_b < e1
+    cr_b = lax.dynamic_slice(creator, (e0,), (bp,))
+    pos_b = lax.dynamic_slice(index, (e0,), (bp,))
+    sp_b = lax.dynamic_slice(self_parent, (e0,), (bp,))
+    cnt = (fr_tab[:, cr_b] <= pos_b[None, :]).sum(0, dtype=jnp.int32)
+    rnd_b = jnp.where(valid_b, rho_min - 1 + cnt, -1)
+    rounds_all = lax.dynamic_update_slice(rounds_host, rnd_b, (e0,))
+    sp_safe = jnp.where(sp_b >= 0, sp_b, 0)
+    wit_b = valid_b & ((sp_b < 0) | (rnd_b > rounds_all[sp_safe]))
+    big = jnp.iinfo(jnp.int32).max // 2
+    min_new = jnp.min(jnp.where(valid_b, rnd_b, big))
+    i0 = jnp.minimum(first_undec_prev, min_new + 1)
 
-@functools.partial(jax.jit, static_argnames=("n", "sm", "rw", "iw"))
-def _fused_fame_rr(wt_win, famous_prev_win, in_list_win, wt_rr, fam_low_rr,
-                   elig_low, rounds, rr_prev, la, fd, creator, index, coin,
-                   chain_rank, rx0, i0, *, n, sm, rw, iw):
-    """Fame + round-received in one dispatch (one host sync per run).
-
-    Fame runs over the window [rx0, rx0+rw) and is merged on device
-    under the reference's undecided-rounds gating (`in_list_win`
-    mirrors hashgraph.go:629-637: only rounds still queued accept fame;
-    a straggler witness in a removed round stays UNDEFINED). Round
-    received then sweeps candidate rounds [i0, i0+iw) — i0 can precede
-    rx0, so the rr windows (`wt_rr`, `fam_low_rr`, `elig_low`) are
-    host-built at offset i0, and rows at i >= rx0 take this call's
-    merged fame and a device-derived eligibility: round fully decided
-    AND below the post-merge first undecided round
-    (hashgraph.go:762-764). rr assignments are final; `rr_prev` keeps
-    them. Returns one packed int32 buffer [rw*n + 2*E] =
-    (famous_merged, rr, cts_rank) — cts only for newly-assigned events —
-    so the host pays a single device->host round trip."""
-    e = rounds.shape[0]
-    k = chain_rank.shape[1]
+    # 3. Fame over the window [rx0, rho_min + rcap): rows gathered from
+    # the swept table (mask instead of slice — rx0 is dynamic and
+    # dynamic_slice would clamp), merged under the undecided-rounds
+    # gating exactly as before.
+    t_w = rx0 - rho_min + jnp.arange(rw)
+    row_ok = (t_w >= 0) & (t_w < rcap)
+    t_wc = jnp.clip(t_w, 0, rcap - 1)
+    wt_win = jnp.where(row_ok[:, None], wt_tab[t_wc], -1)
+    famous_prev_win = jnp.where(row_ok[:, None], fam_rel[t_wc], 0)
+    in_list_win = row_ok & in_list_rel[t_wc]
 
     famous_comp = kernels.decide_fame(
         wt_win, la, fd, index, coin, n=n, sm=sm, r=rw)
@@ -252,17 +281,27 @@ def _fused_fame_rr(wt_win, famous_prev_win, in_list_win, wt_rr, fam_low_rr,
     famous_merged = jnp.where(mergeable, famous_comp, famous_prev_win)
     undec_row = (wt_valid_f & (famous_merged == FAME_UNDEFINED)).any(1)
     still_listed = in_list_win & undec_row
-    t_first = jnp.min(
-        jnp.where(still_listed, jnp.arange(rw), jnp.iinfo(jnp.int32).max // 2)
-    )
+    t_first = jnp.min(jnp.where(still_listed, jnp.arange(rw), big))
     first_undec = rx0 + t_first  # huge when the list empties
 
-    # Combined rr-window fame/eligibility: host values below rx0,
-    # this call's merged values at and above it.
+    # 4. Round received over [i0, rho_min + rcap): fame/eligibility from
+    # the host tables below rx0, from this run's merge at and above it.
     i_vec = i0 + jnp.arange(iw)
+    rel = i_vec - rho_min
+    rel_ok = (rel >= 0) & (rel < rcap)
+    rel_c = jnp.clip(rel, 0, rcap - 1)
+    wt_rr = jnp.where(rel_ok[:, None], wt_tab[rel_c], -1)
     t2 = jnp.clip(i_vec - rx0, 0, rw - 1)
     in_fame_win = i_vec >= rx0
-    fam_rr = jnp.where(in_fame_win[:, None], famous_merged[t2], fam_low_rr)
+    fam_low = jnp.where(rel_ok[:, None], fam_rel[rel_c], 0)
+    fam_rr = jnp.where(in_fame_win[:, None], famous_merged[t2], fam_low)
+    # Decidedness below the fame window is derived from the POST-sweep
+    # witness table, not host state: a straggler witness landing THIS
+    # run in an already-removed round has UNDEFINED fame forever and
+    # must poison the round's witnesses_decided (reference
+    # hashgraph.go:629-637, 762-764).
+    elig_low = rel_ok & ~(
+        (wt_rr >= 0) & (fam_low == FAME_UNDEFINED)).any(1)
     decided_vec = jnp.where(in_fame_win, ~undec_row[t2], elig_low)
     elig = decided_vec & (first_undec > i_vec)
 
@@ -279,27 +318,51 @@ def _fused_fame_rr(wt_win, famous_prev_win, in_list_win, wt_rr, fam_low_rr,
         la_w = la[wt_safe[t]]  # [n(w), n]
         see_wx = la_w[:, creator_e] >= index_e[None, :]  # [n(w), E]
         s_cnt = (see_wx & fmask[t][:, None]).sum(0)
-        ok = elig[t] & (s_cnt > fcnt[t] // 2) & (i > rounds) & (rr < 0)
+        ok = elig[t] & (s_cnt > fcnt[t] // 2) & (i > rounds_all[:e]) & (rr < 0)
         return jnp.where(ok, i, rr)
 
     rr = lax.fori_loop(0, iw, step, rr_prev)
     newly = (rr >= 0) & (rr_prev < 0)
+    newly_count = newly.sum(dtype=jnp.int32)
 
-    t_sel = jnp.clip(rr - i0, 0, iw - 1)
-    w_sel = wt_safe[t_sel]  # [E, n]
+    # Consensus timestamps only for the rows that were JUST assigned —
+    # compacted to a static [cb] bucket so the median machinery (the
+    # [rows, n] gathers and the per-row sort) scales with the sync's
+    # decisions, not with E. argsort(~newly) is stable, so the first
+    # newly_count lanes are exactly the newly-received event ids; if
+    # the bucket overflows (a late fame decision releasing a huge
+    # backlog), newly_count > cb tells the host to redo with a bigger
+    # bucket.
+    order = jnp.argsort(~newly)
+    sel = order[:cb]  # [cb] event ids, newly rows first
+    live = newly[sel]
+    t_sel = jnp.clip(rr[sel] - i0, 0, iw - 1)
+    w_sel = wt_safe[t_sel]  # [cb, n]
     fm_sel = fmask[t_sel]
     idxw_sel = idx_w[t_sel]
-    see_sel = la[w_sel, creator_e[:, None]] >= index_e[:, None]
+    cr_sel = creator_e[sel]
+    ix_sel = index_e[sel]
+    fd_sel = fd[sel]  # [cb, n]
+    see_sel = la[w_sel, cr_sel[:, None]] >= ix_sel[:, None]
     s_mask = see_sel & fm_sel
     s_cnt = s_mask.sum(1)
-    valid_t = fd <= idxw_sel  # first descendant reaches the witness
-    ts_fd = chain_rank[jnp.arange(n)[None, :], jnp.clip(fd, 0, k - 1)]
+    valid_t = fd_sel <= idxw_sel  # first descendant reaches the witness
+    ts_fd = chain_rank[jnp.arange(n)[None, :], jnp.clip(fd_sel, 0, k - 1)]
     tsv = jnp.where(valid_t, ts_fd, ZERO_TS_RANK)
     tvals = jnp.where(s_mask, tsv, INT32_MAX)
     sorted_t = jnp.sort(tvals, axis=1)
     med = jnp.take_along_axis(sorted_t, (s_cnt // 2)[:, None], axis=1)[:, 0]
-    cts = jnp.where(newly, med, ZERO_TS_RANK)
-    return jnp.concatenate([famous_merged.ravel(), rr, cts])
+    # Scatter back to [E]; non-newly lanes (and rows beyond the live
+    # prefix) keep the sentinel.
+    cts = jnp.full((e,), ZERO_TS_RANK, jnp.int32)
+    cts = cts.at[jnp.where(live, sel, e)].set(
+        jnp.where(live, med, ZERO_TS_RANK), mode="drop")
+
+    return jnp.concatenate([
+        t_end[None].astype(jnp.int32), newly_count[None],
+        wt_tab.ravel(), fr_tab.ravel(),
+        rnd_b, wit_b.astype(jnp.int32), famous_merged.ravel(), rr, cts,
+    ])
 
 
 @dataclass
@@ -397,6 +460,8 @@ class IncrementalEngine:
         self.undecided_rounds: List[int] = [0]
         self._queued_rounds = {0}
         self._prev_first_undec = 0
+        self._last_growth = 8  # rounds added by the previous run
+        self._last_newly = 64  # round-received burst size of the last run
         self.last_consensus_round: Optional[int] = None
 
         self._new_since_run: List[int] = []
@@ -535,7 +600,9 @@ class IncrementalEngine:
         if e0 == e:
             return
         b = e - e0
-        bp = _pow2(b)
+        # Floor 64: live-node syncs are small and varied; collapsing
+        # them into one batch bucket avoids a compile per distinct size.
+        bp = _pow2(b, 64)
         while e0 + bp > self._cap_dev + 1 and bp > b:
             bp //= 2
         if bp < b:
@@ -636,7 +703,11 @@ class IncrementalEngine:
         fd = _fd_from_ranks(self._ranks, chain_len_d, cr_d, idx_d, n=n)
         _mark("fd", fd)
 
-        # 3. Witness frontier, warm-started at the first growable row.
+        # 3-6. Frontier, new-event rounds, fame, and round-received in
+        # ONE device dispatch with ONE packed pull (_consensus_fused):
+        # on the tunneled runtime every device->host sync costs a full
+        # round trip, so the windows the host used to build between
+        # pulls are now derived on device from host bookkeeping tables.
         rel_rows = len(self._fr_table)
         if rel_rows:
             growable = (
@@ -651,32 +722,144 @@ class IncrementalEngine:
         else:
             wt_prev = jnp.full((n,), -1, jnp.int32)
             fr_prev = jnp.zeros((n,), jnp.int32)
-        # Single-dispatch device sweep with packed results: ONE
-        # device->host pull (t_end + both tables) per attempt — the
-        # tunnel round-trip is the cost that matters, not the bytes.
+
+        # Batch range for device-side round assignment (contiguous ids;
+        # same floor-64 bucketing as _ingest_batch so live-node syncs
+        # share one compile).
+        e0_b = self._new_since_run[0] if self._new_since_run else e
+        b_new = e - e0_b
+        bp = _pow2(max(b_new, 1), 64)
+        # Bound by cap (not cap+1): the kernel's rounds/rr vectors are
+        # cap long, and a clamped dynamic_update_slice would silently
+        # shift every batch round one slot down.
+        while e0_b + bp > self.cap and bp > b_new:
+            bp //= 2
+        if bp < max(b_new, 1):
+            bp = max(b_new, 1)
+
+        # Timestamp ranks are global-sort positions, recomputed per
+        # call because new timestamps interleave with old ones.
+        ts_values, inv = np.unique(self.ts_ns[:e], return_inverse=True)
+        chain_rank = np.full((n, self.kcap), -1, np.int32)
+        valid = self.chain >= 0
+        safe = np.where(valid, self.chain, 0)
+        ranks = inv.astype(np.int32)
+        chain_rank[valid] = ranks[safe[valid]]
+
+        undecided_set = set(self.undecided_rounds)
+        rounds_up = jnp.asarray(self.rounds[: self.cap])
+        rr_up = jnp.asarray(self.rr[: self.cap])
+        rank_up = jnp.asarray(chain_rank)
+
+        # Fame/rr window widths: the spans actually needed, not the
+        # table capacity — decide_fame costs O(rw^2) sequential steps
+        # and the rr sweep O(iw) sequential [n, E] passes, and on this
+        # runtime the per-step overhead of those loops is the dominant
+        # device cost, so every halving of the window matters. The
+        # widths are PREDICTED from the previous run's observed round
+        # growth (doubled, so steady state never redoes); the post-pull
+        # checks below are the safety net — a misprediction or a
+        # straggler batch (i0 below the known rounds) costs one redo
+        # dispatch, never correctness.
+        growth = 2 * self._last_growth + 2
+        rx0_known = (
+            self.undecided_rounds[0]
+            if self.undecided_rounds else self.rho_min + rel_rows)
+        i0_known = min(self._prev_first_undec, rx0_known)
+        rw = _pow2(max(self.rho_min + rel_rows - rx0_known, 1) + growth)
+        iw = _pow2(max(self.rho_min + rel_rows - i0_known, 1) + growth)
+        # Consensus-timestamp bucket: syncs usually receive about a
+        # batch worth of events; a late fame decision can release a
+        # backlog, detected post-pull (newly_count) and redone bigger.
+        # _last_newly keeps the bucket sticky across bursty stretches.
+        cb = min(_pow2(max(2 * b_new, self._last_newly, 64)), self.cap)
+
         rcap = _pow2(rel_rows + 8, 16)
         while True:
             wt_tab = np.full((rcap, n), -1, np.int32)
             fr_tab = np.full((rcap, n), self.kcap, np.int32)
             wt_tab[:t0] = self._wt_table[:t0]
             fr_tab[:t0] = self._fr_table[:t0]
-            packed = np.asarray(_frontier_packed(
+            # rho_min-relative round bookkeeping from the PREVIOUS run:
+            # fame trileans, queued state (rows beyond the known rounds
+            # default to queued — a new round is queued when its first
+            # event lands), and rr eligibility for already-decided
+            # rounds (witnesses_decided, poisoned-straggler aware).
+            fam_rel = np.zeros((rcap, n), np.int32)
+            in_list_rel = np.ones(rcap, np.bool_)
+            span = min(rel_rows, rcap)
+            for t in range(span):
+                rho = self.rho_min + t
+                fam_rel[t] = self.famous[rho]
+                in_list_rel[t] = rho in undecided_set
+            rx0 = (
+                self.undecided_rounds[0]
+                if self.undecided_rounds else self.rho_min + rcap)
+            packed = np.asarray(_consensus_fused(
                 self._chain_la, self._chain_rb, chain_len_d, la, fd, rb,
                 self._chain_d, jnp.asarray(wt_tab), jnp.asarray(fr_tab),
                 wt_prev, fr_prev, jnp.int32(t0), jnp.int32(self.rho_min),
-                n=n, sm=sm, rcap=rcap))
+                self._sp_d, cr_d, idx_d, coin_d,
+                jnp.int32(e0_b), jnp.int32(e), rounds_up, rr_up,
+                jnp.asarray(fam_rel), jnp.asarray(in_list_rel),
+                rank_up, jnp.int32(rx0),
+                jnp.int32(self._prev_first_undec),
+                n=n, sm=sm, rcap=rcap, bp=bp, rw=rw, iw=iw, cb=cb))
             t_end = int(packed[0])
-            if t_end < rcap:
-                break
-            rcap *= 2
-        tabs = packed[1:].reshape(2, rcap, n)
-        wt_all = tabs[0, :t_end]
-        fr_all = tabs[1, :t_end]
+            newly_count = int(packed[1])
+            if t_end == rcap:
+                # Frontier overflow: the fame/rr results were computed
+                # against a truncated table. They are a safe subset
+                # (eligibility is gated by the first undecided round, so
+                # no wrong or out-of-order assignment is possible) but
+                # incomplete — discard and redo at double capacity.
+                rcap *= 2
+                continue
+            # Window overflow: in-window results are a valid subset
+            # (decisions are monotone in voting rounds; rr assignments
+            # outside the window simply stay unassigned) but rounds
+            # beyond the windows were never processed — redo with the
+            # exact spans now known from the pull. Likewise a
+            # timestamp-bucket overflow (a fame decision released more
+            # events than cb) redoes with the exact count.
+            rnd_b = packed[2 + 2 * rcap * n:2 + 2 * rcap * n + bp]
+            valid_b = rnd_b >= 0
+            min_new = int(rnd_b[valid_b].min()) if valid_b.any() else None
+            r_hi = self.rho_min + t_end
+            i0_true = self._prev_first_undec
+            if min_new is not None:
+                i0_true = min(i0_true, min_new + 1)
+            if (r_hi - rx0 > rw or r_hi - i0_true > iw
+                    or newly_count > cb):
+                rw = _pow2(max(r_hi - rx0, 1))
+                iw = _pow2(max(r_hi - i0_true, 1))
+                cb = min(_pow2(max(newly_count, 64)), self.cap)
+                continue
+            break
+
+        off = 2
+        tabs = packed[off:off + 2 * rcap * n].reshape(2, rcap, n)
+        off += 2 * rcap * n
+        wt_all = tabs[0][:t_end]
+        fr_all = tabs[1][:t_end]
+        rnd_b = packed[off:off + bp]
+        off += bp
+        wit_b = packed[off:off + bp]
+        off += bp
+        famous_merged = packed[off:off + rw * n].reshape(rw, n)
+        off += rw * n
+        rr_np = packed[off:off + self.cap]
+        off += self.cap
+        cts_np = packed[off:]
+        _mark("consensus")
+
         active = (fr_all < self.chain_len[None, :]).any(axis=1)
         n_rows = int(np.nonzero(active)[0][-1]) + 1 if active.any() else 0
         self._fr_table = fr_all[:n_rows]
         self._wt_table = wt_all[:n_rows]
         self._chain_len_prev = self.chain_len.copy()
+        self._last_growth = max(n_rows - rel_rows, 1)
+        self._last_newly = max(newly_count, 64)
         r_total = self.rho_min + n_rows
         wt_abs = np.full((r_total, n), -1, np.int32)
         if n_rows:
@@ -685,132 +868,65 @@ class IncrementalEngine:
             grown = np.zeros((r_total, n), np.int32)
             grown[: self.famous.shape[0]] = self.famous
             self.famous = grown
-        _mark("frontier")
 
         delta = RunDelta()
 
-        # 4. Rounds + witness flags for the new events (host closed form
-        # over the frontier table: round = rho_min - 1 + #rows whose
-        # frontier position <= the event's chain position).
-        min_new_round = None
-        for i in self._new_since_run:
-            c, pos = int(self.creator[i]), int(self.index[i])
-            rnd = self.rho_min - 1 + int(
-                np.searchsorted(self._fr_table[:, c], pos, side="right"))
-            sp = int(self.self_parent[i])
-            wit = sp < 0 or rnd > int(self.rounds[sp])
+        # Host mirrors of the device-computed rounds (reference
+        # DivideRounds bookkeeping, hashgraph.go:616-646).
+        for j, i in enumerate(self._new_since_run):
+            rnd = int(rnd_b[j])
+            wit = bool(wit_b[j])
             self.rounds[i] = rnd
             self.witness[i] = wit
             delta.new_rounds.append((i, rnd, wit))
-            if min_new_round is None or rnd < min_new_round:
-                min_new_round = rnd
             if rnd not in self._queued_rounds:
                 self._queued_rounds.add(rnd)
                 bisect.insort(self.undecided_rounds, rnd)
 
-        _mark("rounds")
-
-        # 5+6. Fame and round-received fused into one dispatch: the
-        # device merges fame under the undecided-rounds gating and
-        # derives the rr eligibility from the merged state, so the run
-        # costs one host sync here instead of two.
-        rx0 = (
-            self.undecided_rounds[0]
-            if self.undecided_rounds else r_total)
-        i0 = self._prev_first_undec
-        if min_new_round is not None:
-            i0 = min(i0, min_new_round + 1)
-        if min(rx0, i0) < r_total:
-            rw = _pow2(max(r_total - rx0, 1))
-            iw = _pow2(max(r_total - i0, 1))
-            span_f = max(r_total - rx0, 0)
-            wt_win = np.full((rw, n), -1, np.int32)
-            fam_prev_win = np.zeros((rw, n), np.int32)
-            in_list_win = np.zeros(rw, np.bool_)
-            wt_win[:span_f] = wt_abs[rx0:]
-            fam_prev_win[:span_f] = self.famous[rx0:r_total]
-            undecided_set = set(self.undecided_rounds)
-            for t in range(span_f):
-                in_list_win[t] = (rx0 + t) in undecided_set
-
-            span_r = r_total - i0
-            wt_rr = np.full((iw, n), -1, np.int32)
-            fam_low_rr = np.zeros((iw, n), np.int32)
-            elig_low = np.zeros(iw, np.bool_)
-            wt_rr[:span_r] = wt_abs[i0:]
-            for t in range(min(span_r, max(rx0 - i0, 0))):
-                i = i0 + t  # rounds below rx0: fame is frozen host state
-                fam_low_rr[t] = self.famous[i]
-                slots = wt_abs[i] >= 0
-                elig_low[t] = not (
-                    slots & (self.famous[i] == FAME_UNDEFINED)).any()
-
-            # Timestamp ranks are global-sort positions, recomputed per
-            # call because new timestamps interleave with old ones.
-            ts_values, inv = np.unique(self.ts_ns[:e], return_inverse=True)
-            chain_rank = np.full((n, self.kcap), -1, np.int32)
-            valid = self.chain >= 0
-            safe = np.where(valid, self.chain, 0)
-            ranks = inv.astype(np.int32)
-            chain_rank[valid] = ranks[safe[valid]]
-
-            packed_f = np.asarray(_fused_fame_rr(
-                jnp.asarray(wt_win), jnp.asarray(fam_prev_win),
-                jnp.asarray(in_list_win), jnp.asarray(wt_rr),
-                jnp.asarray(fam_low_rr), jnp.asarray(elig_low),
-                jnp.asarray(self.rounds[: self.cap]),
-                jnp.asarray(self.rr[: self.cap]),
-                la, fd, cr_d, idx_d, coin_d, jnp.asarray(chain_rank),
-                jnp.int32(rx0), jnp.int32(i0), n=n, sm=sm, rw=rw, iw=iw))
-            famous_merged = packed_f[: rw * n].reshape(rw, n)
-            rr_np = packed_f[rw * n: rw * n + self.cap]
-            cts_np = packed_f[rw * n + self.cap:]
-
-            # Host mirror of DecideFame's bookkeeping from the pulled
-            # fame window (hashgraph.go:649-730).
-            for rho in list(self.undecided_rounds):
-                if rho >= r_total:
+        # Host mirror of DecideFame's bookkeeping from the pulled
+        # fame window (hashgraph.go:649-730).
+        for rho in list(self.undecided_rounds):
+            if rho >= r_total:
+                continue
+            t = rho - rx0
+            row_decided = True
+            for c in range(n):
+                if wt_abs[rho, c] < 0:
                     continue
-                t = rho - rx0
-                row_decided = True
-                for c in range(n):
-                    if wt_abs[rho, c] < 0:
-                        continue
-                    if self.famous[rho, c] == FAME_UNDEFINED:
-                        f = int(famous_merged[t, c])
-                        if f != FAME_UNDEFINED:
-                            self.famous[rho, c] = f
-                            delta.fame_updates.append(
-                                (rho, int(wt_abs[rho, c]), f == FAME_TRUE))
-                    if self.famous[rho, c] == FAME_UNDEFINED:
-                        row_decided = False
-                if row_decided:
-                    self.undecided_rounds.remove(rho)
-                    delta.newly_decided_rounds.append(rho)
-                    if (self.last_consensus_round is None
-                            or rho > self.last_consensus_round):
-                        self.last_consensus_round = rho
-                        delta.last_commited_round_events = int(
-                            (self.rounds[:e] == rho - 1).sum())
+                if self.famous[rho, c] == FAME_UNDEFINED:
+                    f = int(famous_merged[t, c])
+                    if f != FAME_UNDEFINED:
+                        self.famous[rho, c] = f
+                        delta.fame_updates.append(
+                            (rho, int(wt_abs[rho, c]), f == FAME_TRUE))
+                if self.famous[rho, c] == FAME_UNDEFINED:
+                    row_decided = False
+            if row_decided:
+                self.undecided_rounds.remove(rho)
+                delta.newly_decided_rounds.append(rho)
+                if (self.last_consensus_round is None
+                        or rho > self.last_consensus_round):
+                    self.last_consensus_round = rho
+                    delta.last_commited_round_events = int(
+                        (self.rounds[:e] == rho - 1).sum())
 
-            newly = (rr_np >= 0) & (self.rr[: self.cap] < 0)
-            newly[e:] = False
-            for i in np.nonzero(newly)[0]:
-                rr_i = int(rr_np[i])
-                rank = int(cts_np[i])
-                self.rr[i] = rr_i
-                if rank == ZERO_TS_RANK:
-                    self.cts_ns[i] = CTS_SENTINEL
-                    ns = ZERO_TIME_NS
-                else:
-                    ns = int(ts_values[rank])
-                    self.cts_ns[i] = ns
-                delta.new_received.append((int(i), rr_i, ns))
+        newly = (rr_np >= 0) & (self.rr[: self.cap] < 0)
+        newly[e:] = False
+        for i in np.nonzero(newly)[0]:
+            rr_i = int(rr_np[i])
+            rank = int(cts_np[i])
+            self.rr[i] = rr_i
+            if rank == ZERO_TS_RANK:
+                self.cts_ns[i] = CTS_SENTINEL
+                ns = ZERO_TIME_NS
+            else:
+                ns = int(ts_values[rank])
+                self.cts_ns[i] = ns
+            delta.new_received.append((int(i), rr_i, ns))
         delta.last_consensus_round = self.last_consensus_round
         self._prev_first_undec = (
             self.undecided_rounds[0] if self.undecided_rounds else r_total)
 
-        _mark("fame_rr")
         self._new_since_run = []
         self._empty_delta_ok = True
         return delta

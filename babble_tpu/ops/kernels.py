@@ -29,6 +29,15 @@ FAME_UNDEFINED = 0
 FAME_TRUE = 1
 FAME_FALSE = 2
 
+# BABBLE_PALLAS=1 swaps the strongly-see contraction in decide_fame for
+# the opt-in pallas kernel. Read ONCE at import and fixed for the
+# process lifetime: decide_fame is jitted, so a mid-process toggle
+# would silently keep serving whichever variant was compiled first for
+# a given shape (the jit cache does not key on the environment).
+import os as _os  # noqa: E402
+
+_PALLAS = _os.environ.get("BABBLE_PALLAS") == "1"
+
 
 @functools.partial(jax.jit, static_argnames=("n",))
 def compute_last_ancestors(self_parent, other_parent, creator, index, levels, *, n):
@@ -238,9 +247,7 @@ def decide_fame(wt, la, fd, index, coin, *, n, sm, r):
     # per-round hot op at large n); the XLA broadcast-compare-reduce is
     # the bit-identical default. The pallas module is only imported when
     # the flag is set, so the default path never depends on it.
-    import os as _os
-
-    pallas_ss = _os.environ.get("BABBLE_PALLAS") == "1"
+    pallas_ss = _PALLAS
     if pallas_ss:
         from .pallas_kernels import strongly_see_counts_auto
 

@@ -14,9 +14,10 @@ the way a matmul kernel would walk its K axis (guide:
 /opt/skills/guides/pallas_guide.md).
 
 Opt-in (BABBLE_PALLAS=1): the default paths keep the XLA formulation,
-which is bit-identical; kernels.decide_fame consults use_pallas() when
-tracing. On CPU backends the kernel runs in interpreter mode so tests
-exercise it without TPU hardware.
+which is bit-identical; kernels.decide_fame reads the flag ONCE at
+import (kernels._PALLAS — process-lifetime semantics, because the jit
+cache does not key on the environment). On CPU backends the kernel runs
+in interpreter mode so tests exercise it without TPU hardware.
 """
 
 from __future__ import annotations
@@ -34,7 +35,8 @@ CHUNK = 128  # lane-aligned participant-axis step: one 8 MB compare cube in VMEM
 
 
 def use_pallas() -> bool:
-    """Opt-in switch, read at trace time."""
+    """Opt-in switch. kernels.py snapshots this at import; a mid-process
+    toggle does not affect already-compiled shapes."""
     return os.environ.get("BABBLE_PALLAS") == "1"
 
 

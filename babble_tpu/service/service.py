@@ -1,22 +1,41 @@
 """GET /Stats -> JSON of the node's live counters, with permissive CORS
-— reference service/service.go:17-65."""
+— reference service/service.go:17-65 — plus GET /debug/profile, the
+live-profiling counterpart of the reference's pprof mount
+(reference cmd/babble/main.go:12) re-targeted at the device: it
+captures a JAX profiler trace of the running node for N seconds."""
 
 from __future__ import annotations
 
 import json
+import os
+import tempfile
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 
 class Service:
     def __init__(self, bind_addr: str, node):
         host, port_s = bind_addr.rsplit(":", 1)
         self.node = node
+        self._profile_lock = threading.Lock()
+        self._profile_dir = None
         service = self
 
         class Handler(BaseHTTPRequestHandler):
+            def _json(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):  # noqa: N802 - stdlib API
-                if self.path.rstrip("/") in ("/Stats", "/stats", ""):
+                url = urlparse(self.path)
+                if url.path.rstrip("/") in ("/Stats", "/stats", ""):
                     body = json.dumps(service.node.get_stats()).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
@@ -32,6 +51,44 @@ class Service:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif url.path.rstrip("/") == "/debug/profile":
+                    # Like the reference's pprof mount, this is an
+                    # operator tool: bind service_addr to localhost in
+                    # production (docs/usage.md). Each capture reuses
+                    # ONE per-service directory (previous trace is
+                    # replaced), so repeated calls cannot fill /tmp.
+                    try:
+                        secs = float(
+                            parse_qs(url.query).get("seconds", ["5"])[0])
+                        secs = min(max(secs, 0.1), 30.0)
+                    except ValueError:
+                        self._json(400, {"error": "bad seconds"})
+                        return
+                    if not service._profile_lock.acquire(blocking=False):
+                        self._json(409, {"error": "profile in progress"})
+                        return
+                    try:
+                        import shutil
+
+                        import jax
+
+                        if service._profile_dir is None:
+                            service._profile_dir = tempfile.mkdtemp(
+                                prefix="babble-profile-")
+                        else:
+                            shutil.rmtree(service._profile_dir,
+                                          ignore_errors=True)
+                            os.makedirs(service._profile_dir,
+                                        exist_ok=True)
+                        jax.profiler.start_trace(service._profile_dir)
+                        time.sleep(secs)
+                        jax.profiler.stop_trace()
+                        self._json(200, {"trace_dir": service._profile_dir,
+                                         "seconds": secs})
+                    except Exception as exc:  # noqa: BLE001
+                        self._json(500, {"error": str(exc)})
+                    finally:
+                        service._profile_lock.release()
                 else:
                     self.send_response(404)
                     self.end_headers()

@@ -212,6 +212,10 @@ def _budget_left():
 
 
 def time_pipeline(dag, s_rank, warm=1, reps=3, engine="auto"):
+    """Times `reps` full runs; returns (best, median, times, n_consensus,
+    max_round). The chip is shared and tunneled (observed +/-40%
+    run-to-run), so median-with-spread is the honest number and best is
+    reported alongside, never alone."""
     import numpy as np
 
     from babble_tpu.ops.pipeline import run_pipeline
@@ -221,7 +225,7 @@ def time_pipeline(dag, s_rank, warm=1, reps=3, engine="auto"):
         out = run_pipeline(dag, engine=engine)
         np.asarray(out[0])
     log(f"  [{engine}] compile+warmup {time.monotonic() - t0:.1f}s")
-    best = float("inf")
+    times = []
     n_consensus = 0
     max_round = 0
     for _ in range(reps):
@@ -231,12 +235,11 @@ def time_pipeline(dag, s_rank, warm=1, reps=3, engine="auto"):
         # host finish: the consensus total order (rr, ts, S-tiebreak)
         mask = rr >= 0
         np.lexsort((s_rank[mask], cts[mask], rr[mask]))
-        dt = time.perf_counter() - t0
-        if dt < best:
-            best = dt
-            n_consensus = int(mask.sum())
-            max_round = int(rounds.max())
-    return best, n_consensus, max_round
+        times.append(time.perf_counter() - t0)
+        n_consensus = int(mask.sum())
+        max_round = int(rounds.max())
+    return (min(times), float(np.median(times)), times, n_consensus,
+            max_round)
 
 
 def tune_engine(dag, s_rank):
@@ -249,8 +252,8 @@ def tune_engine(dag, s_rank):
         if _budget_left() < 60:
             break
         try:
-            best, _, _ = time_pipeline(dag, s_rank, warm=1, reps=1,
-                                       engine=engine)
+            best, _, _, _, _ = time_pipeline(dag, s_rank, warm=1, reps=1,
+                                             engine=engine)
             results[engine] = best
             log(f"  tune: {engine} {best * 1e3:.1f} ms")
         except Exception as exc:  # noqa: BLE001
@@ -302,6 +305,84 @@ def host_engine_events_per_sec(n_peers, n_events, seed=7):
     return len(h.consensus_events()) / dt, len(h.consensus_events()), dt
 
 
+def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
+                                window_s=30.0):
+    """Throughput of a live localhost testnet: N real nodes (threads,
+    inmem transport, signed events, full sync protocol) bombarded with
+    transactions; returns committed consensus events/sec during a
+    steady-state window after a warmup (compiles + cache fill). The
+    reference's counterpart is the 4-node docker demo steady state
+    (reference docs/usage.rst:31-34)."""
+    import threading
+
+    from babble_tpu import crypto
+    from babble_tpu.hashgraph import InmemStore
+    from babble_tpu.net import InmemTransport, Peer
+    from babble_tpu.net.inmem_transport import connect_all
+    from babble_tpu.node import Node
+    from babble_tpu.node.config import test_config
+    from babble_tpu.proxy import InmemAppProxy
+
+    keys = [crypto.key_from_seed(9000 + i) for i in range(n_nodes)]
+    entries = []
+    for i, k in enumerate(keys):
+        pub_hex = "0x" + crypto.pub_key_bytes(k).hex().upper()
+        entries.append((k, Peer(f"addr{i}", pub_hex)))
+    entries.sort(key=lambda kp: kp[1].pub_key_hex)
+    transports = [InmemTransport(p.net_addr, timeout=2.0)
+                  for _, p in entries]
+    connect_all(transports)
+    peers = [p for _, p in entries]
+    participants = {p.pub_key_hex: i for i, p in enumerate(peers)}
+    nodes = []
+    for i, (key, peer) in enumerate(entries):
+        conf = test_config(heartbeat=0.01, cache_size=100000)
+        conf.engine = engine
+        if engine == "tpu":
+            # Batch several syncs per device pass: gossip stays at wire
+            # speed, the engine drains the backlog in device-sized
+            # batches (4 nodes share one ~90 ms-RTT chip here).
+            conf.consensus_interval = 0.25
+        node = Node(conf, i, key, peers, InmemStore(participants, 100000),
+                    transports[i], InmemAppProxy())
+        node.init()
+        nodes.append(node)
+
+    stop = threading.Event()
+
+    def bombard():
+        i = 0
+        while not stop.is_set():
+            try:
+                nodes[i % n_nodes].submit_tx(f"bench tx {i}".encode())
+            except Exception:  # noqa: BLE001
+                pass
+            i += 1
+            time.sleep(0.002)
+
+    committed = lambda: min(  # noqa: E731
+        len(nd.core.get_consensus_events()) for nd in nodes)
+    try:
+        for nd in nodes:
+            nd.run_async(gossip=True)
+        bomber = threading.Thread(target=bombard, daemon=True)
+        bomber.start()
+        deadline = time.monotonic() + warm_s
+        while time.monotonic() < deadline and committed() < 50:
+            time.sleep(0.5)
+        c0, t0 = committed(), time.monotonic()
+        time.sleep(window_s)
+        c1, t1 = committed(), time.monotonic()
+    finally:
+        stop.set()
+        for nd in nodes:
+            nd.shutdown()
+    if c1 <= c0:
+        raise RuntimeError(
+            f"testnet made no progress in the window ({c0} -> {c1})")
+    return (c1 - c0) / (t1 - t0)
+
+
 def child():
     import jax
 
@@ -327,7 +408,7 @@ def child():
     # -- stage 0: smoke ----------------------------------------------------
     log("stage smoke: n=8 e=256")
     dag, s_rank = synthetic_dag(8, 256, seed=0)
-    best, n_cons, _ = time_pipeline(dag, s_rank, warm=1, reps=2)
+    best, _, _, n_cons, _ = time_pipeline(dag, s_rank, warm=1, reps=2)
     log(f"  smoke ok: {best * 1e3:.1f} ms, {n_cons} consensus events")
     payload["smoke_events_per_s"] = round(n_cons / best, 1)
     _emit(payload)
@@ -345,15 +426,23 @@ def child():
         log(f"  tuned engine: {engine}")
         if profile_dir:
             jax.profiler.start_trace(profile_dir)
-        best, n_cons, max_round = time_pipeline(dag, s_rank, engine=engine)
+        best, med, times, n_cons, max_round = time_pipeline(
+            dag, s_rank, reps=5, engine=engine)
         if profile_dir:
             jax.profiler.stop_trace()
-        eps = n_cons / best
-        log(f"  headline: {best * 1e3:.1f} ms -> {n_cons} consensus events "
-            f"({eps:,.0f} ev/s), last round {max_round}")
+        eps = n_cons / med
+        log(f"  headline: median {med * 1e3:.1f} ms (best {best * 1e3:.1f}, "
+            f"spread {min(times) * 1e3:.0f}-{max(times) * 1e3:.0f} ms) -> "
+            f"{n_cons} consensus events ({eps:,.0f} ev/s median), "
+            f"last round {max_round}")
+        # The headline metric is the MEDIAN of 5 runs; best and the full
+        # spread ride along (the shared chip varies +/-40% run to run).
         payload["value"] = round(eps, 1)
         payload["engine"] = engine
-        payload["headline_ms"] = round(best * 1e3, 2)
+        payload["headline_ms"] = round(med * 1e3, 2)
+        payload["headline_best_ms"] = round(best * 1e3, 2)
+        payload["headline_best_events_per_s"] = round(n_cons / best, 1)
+        payload["headline_spread_ms"] = [round(t * 1e3, 1) for t in times]
         payload["headline_consensus_events"] = n_cons
         _emit(payload)
 
@@ -403,20 +492,59 @@ def child():
         total = time.perf_counter() - t0
         if e_sus % bs:  # final partial batch would skew the per-batch rate
             per_batch = per_batch[:-1]
-        steady = float(_np.median(per_batch[len(per_batch) // 2:]))
+        half = per_batch[len(per_batch) // 2:]
+        steady = float(_np.median(half))
         log(f"  sustained: {total:.1f}s total ({e_sus / total:,.0f} ev/s), "
-            f"steady {bs / steady:,.0f} ev/s, "
+            f"steady {bs / steady:,.0f} ev/s "
+            f"(per-batch spread {min(half):.2f}-{max(half):.2f}s), "
             f"{int((eng.rr[:e_sus] >= 0).sum())} consensus")
         payload["sustained_events_per_s"] = round(e_sus / total, 1)
         payload["sustained_steady_events_per_s"] = round(bs / steady, 1)
+        payload["sustained_steady_spread_s"] = [
+            round(min(half), 3), round(max(half), 3)]
         payload["sustained_batch"] = bs
         _emit(payload)
+
+    on_cpu = jax.default_backend() == "cpu"
+
+    # -- stage 2c: the real gossiping node --------------------------------
+    # 4 live nodes (threads, inmem transport, per-event ECDSA, the full
+    # sync protocol) — the apples-to-apples number against the
+    # reference's 4-node docker steady state (265.53-268.27 ev/s,
+    # reference docs/usage.rst:31-34). Two rows: the host engine (the
+    # like-for-like configuration — 4 independent consensus engines on
+    # one machine, as the reference runs), and the TPU engine, where
+    # all 4 nodes time-share ONE tunneled chip (~90 ms per device sync)
+    # — honest, but hardware-limited in a way a per-validator
+    # accelerator deployment is not.
+    if os.environ.get("BENCH_SKIP_NODE") != "1":
+        if _budget_left() > 180:
+            try:
+                node_eps = node_testnet_events_per_sec(
+                    engine="host", warm_s=30.0, window_s=30.0)
+                log(f"  4-node --engine host testnet: {node_eps:,.1f} "
+                    f"committed events/s (ref docker: {ref_docker})")
+                payload["node_events_per_s"] = round(node_eps, 1)
+                payload["node_vs_ref_docker"] = round(
+                    node_eps / ref_docker, 2)
+                _emit(payload)
+            except Exception as exc:  # noqa: BLE001
+                log(f"  node host stage failed: {exc}")
+        if _budget_left() > 300 and not on_cpu:
+            try:
+                node_eps = node_testnet_events_per_sec(
+                    engine="tpu", warm_s=120.0, window_s=30.0)
+                log(f"  4-node --engine tpu testnet (one shared chip): "
+                    f"{node_eps:,.1f} committed events/s")
+                payload["node_tpu_events_per_s"] = round(node_eps, 1)
+                _emit(payload)
+            except Exception as exc:  # noqa: BLE001
+                log(f"  node tpu stage failed: {exc}")
 
     # -- stage 3: north star n=1024 e=100k --------------------------------
     # Skipped on the CPU fallback: at this size a host CPU cannot finish
     # inside any reasonable budget, and the number is only meaningful on
     # the chip (BASELINE.md north-star target).
-    on_cpu = jax.default_backend() == "cpu"
     force_ns = os.environ.get("BENCH_FORCE_NORTHSTAR") == "1"
     if _budget_left() > 300 and (not on_cpu or force_ns):
         n, e = 1024, 100_000
@@ -431,15 +559,61 @@ def child():
             # re-tune at this size instead of reusing the headline's.
             engine_ns = tune_engine(dag, s_rank)
             log(f"  tuned northstar engine: {engine_ns}")
-            best, n_cons, max_round = time_pipeline(dag, s_rank, warm=1,
-                                                    reps=2, engine=engine_ns)
-            eps = n_cons / best
-            log(f"  northstar: {best * 1e3:.1f} ms -> {n_cons} consensus "
-                f"({eps:,.0f} ev/s), last round {max_round}")
+            best, med, times, n_cons, max_round = time_pipeline(
+                dag, s_rank, warm=1, reps=3, engine=engine_ns)
+            eps = n_cons / med
+            log(f"  northstar: median {med * 1e3:.1f} ms "
+                f"(spread {min(times) * 1e3:.0f}-{max(times) * 1e3:.0f}) -> "
+                f"{n_cons} consensus ({eps:,.0f} ev/s), "
+                f"last round {max_round}")
             payload["northstar_events_per_s"] = round(eps, 1)
+            payload["northstar_best_events_per_s"] = round(n_cons / best, 1)
+            payload["northstar_spread_ms"] = [
+                round(t * 1e3, 1) for t in times]
             payload["northstar_n"] = n
             payload["northstar_events"] = e
             _emit(payload)
+
+            # North-star INCREMENTAL: the engine a live `--engine tpu`
+            # node actually drives (ops/incremental.py), fed the same
+            # DAG in sync-sized batches — the validated at-scale number
+            # VERDICT r3 asked for (run on the real chip, value-pulling
+            # every sync).
+            if _budget_left() > 240:
+                from babble_tpu.ops.incremental import IncrementalEngine
+                import numpy as _np
+
+                bs_ns = 4096
+                log(f"stage northstar incremental: n={n} e={e} "
+                    f"batch={bs_ns}")
+                eng = IncrementalEngine(
+                    n, capacity=131072, block=512, k_capacity=512)
+                t0 = time.perf_counter()
+                per_b = []
+                k = 0
+                while k < e:
+                    hi = min(k + bs_ns, e)
+                    eng.append_batch(
+                        dag.self_parent[k:hi], dag.other_parent[k:hi],
+                        dag.creator[k:hi], dag.index[k:hi],
+                        dag.coin[k:hi], _np.arange(k, hi))
+                    tb = time.perf_counter()
+                    eng.run()
+                    per_b.append(time.perf_counter() - tb)
+                    k = hi
+                total_ns = time.perf_counter() - t0
+                half = per_b[len(per_b) // 2:]
+                steady_ns = float(_np.median(half))
+                n_cons_inc = int((eng.rr[:e] >= 0).sum())
+                log(f"  northstar incremental: {total_ns:.1f}s "
+                    f"({e / total_ns:,.0f} ev/s), steady "
+                    f"{bs_ns / steady_ns:,.0f} ev/s, "
+                    f"{n_cons_inc} consensus")
+                payload["northstar_incremental_events_per_s"] = round(
+                    e / total_ns, 1)
+                payload["northstar_incremental_steady_events_per_s"] = (
+                    round(bs_ns / steady_ns, 1))
+                _emit(payload)
 
             # Honest wall-clock multiple at this scale (BASELINE.md
             # driver target: >=100x at n=1024/100k): the host engine

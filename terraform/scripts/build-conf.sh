@@ -21,4 +21,8 @@ for i in range(n):
     json.dump(peers, open(f"{tmp}/node{i}/peers.json", "w"))
 PY
 gsutil -m cp -r "$TMP"/node* "gs://$BUCKET/"
-echo "uploaded conf for $NODES nodes to gs://$BUCKET"
+# Ship the package wheel alongside the conf — startup.sh installs it.
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+(cd "$REPO" && python -m build --wheel --outdir "$TMP/dist")
+gsutil -m cp "$TMP"/dist/babble_tpu-*.whl "gs://$BUCKET/dist/"
+echo "uploaded conf for $NODES nodes + package wheel to gs://$BUCKET"

@@ -8,6 +8,11 @@ IDX=$(curl -fs -H "Metadata-Flavor: Google" \
 BUCKET=$(curl -fs -H "Metadata-Flavor: Google" \
   "http://metadata/computeMetadata/v1/instance/attributes/conf-bucket")
 pip install "jax[tpu]" numpy cryptography
+# The conf bucket carries a wheel built by `make dist` (uploaded
+# alongside the per-node datadirs by terraform's conf step) — install
+# the actual babble_tpu package, not just its dependencies.
+gsutil cp "gs://$BUCKET/dist/"babble_tpu-*.whl /tmp/
+pip install /tmp/babble_tpu-*.whl
 gsutil -m cp -r "gs://$BUCKET/node$IDX" /opt/babble-conf
 exec python -m babble_tpu.cli run \
   --datadir /opt/babble-conf \

@@ -25,5 +25,24 @@ def test_stats_endpoint():
         assert stats["id"] == "0" or stats["id"].isdigit()
         assert float(stats["events_per_second"]) > 0
         check_gossip(nodes)
+
+        # live device profiling (reference mounts pprof on the same mux,
+        # cmd/babble/main.go:12)
+        with urllib.request.urlopen(
+            f"http://{service.addr}/debug/profile?seconds=0.2", timeout=30
+        ) as r:
+            assert r.status == 200
+            info = json.loads(r.read())
+        assert "trace_dir" in info
+        import os
+
+        assert os.path.isdir(info["trace_dir"])
+        try:
+            urllib.request.urlopen(
+                f"http://{service.addr}/debug/profile?seconds=nope",
+                timeout=5)
+            raise AssertionError("bad seconds accepted")
+        except urllib.error.HTTPError as err:
+            assert err.code == 400
     finally:
         service.close()
