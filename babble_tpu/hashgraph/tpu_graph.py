@@ -25,6 +25,8 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional
 
+import numpy as np
+
 from ..gojson import Timestamp, ZERO_TIME
 from ..ops.incremental import IncrementalEngine, RunDelta, ZERO_TIME_NS
 from .block import Block
@@ -47,6 +49,8 @@ class TpuHashgraph(Hashgraph):
         block: int = 256,
     ):
         super().__init__(participants, store, commit_callback)
+        self._capacity = capacity
+        self._block = block
         self.engine = IncrementalEngine(
             len(participants), capacity=capacity, block=block)
         self._eid_of: Dict[str, int] = {}
@@ -211,9 +215,25 @@ class TpuHashgraph(Hashgraph):
     # -- checkpoint / recovery ----------------------------------------------
 
     def reset(self, roots: Dict[str, Root]) -> None:
-        raise NotImplementedError(
-            "TpuHashgraph does not support frame reset (offset chain "
-            "bases); the reference's fast-sync consumer is a stub "
-            "(node/node.go:432-441) — use the host engine for "
-            "reset-from-frame flows"
-        )
+        """Frame reset (reference hashgraph.go:879-898): clear the
+        Store down to the given Roots and rebuild the device engine
+        with offset chain bases — each Root contributes its round as
+        the creator's root_round (propagated by the closure as rbase)
+        and index+1 as the creator's chain-position offset. Replayed
+        frame events then append at position 0 exactly as a fresh
+        graph's do."""
+        super().reset(roots)
+        n = len(self.participants)
+        root_round = np.full(n, -1, np.int32)
+        index_base = np.zeros(n, np.int32)
+        for pk, pid in self.participants.items():
+            r = roots.get(pk)
+            if r is not None:
+                root_round[pid] = r.round
+                index_base[pid] = r.index + 1
+        self.engine = IncrementalEngine(
+            n, root_round, capacity=self._capacity, block=self._block,
+            index_base=index_base, from_reset=True)
+        self._eid_of = {}
+        self._hex_by_id = []
+        self.undecided_rounds = list(self.engine.undecided_rounds)

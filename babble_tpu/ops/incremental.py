@@ -31,10 +31,17 @@ per-sync work instead of full-DAG recompute:
                 witnesses_decided (hashgraph.go:629-637, 762-764).
 
 Capacity, chain length, and round windows are bucketed to powers of two
-so steady-state syncs never recompile. Base roots only (index 0 chain
-starts): frame-reset graphs with offset indexes stay on the host engine
-(the node's fast-sync path is a reference-parity stub anyway,
-node/node.go:432-441).
+so steady-state syncs never recompile.
+
+Frame reset (reference hashgraph.go:879-898): the engine is
+position-based internally — coordinates, frontier positions, and the fd
+rank cube all index chain POSITIONS, not Go event indexes — so offset
+chain bases (Root.index != -1) reduce to a per-creator `index_base`
+subtracted at append time, and offset round bases (Root.round != -1)
+ride the existing per-participant `root_round` vector the closure
+propagates as rbase. A reset engine starts with an empty
+undecided-rounds queue (the host mirror's reset() does the same;
+rounds re-queue as replayed events land, graph.py divide_rounds).
 """
 
 from __future__ import annotations
@@ -390,7 +397,8 @@ class IncrementalEngine:
     """
 
     def __init__(self, n: int, root_round=None, *, capacity: int = 256,
-                 block: int = 256, k_capacity: int = 64):
+                 block: int = 256, k_capacity: int = 64,
+                 index_base=None, from_reset: bool = False):
         if n < 1:
             raise ValueError("need at least one participant")
         self.n = n
@@ -399,6 +407,13 @@ class IncrementalEngine:
         self.root_round = (
             np.full(n, -1, np.int32) if root_round is None
             else np.asarray(root_round, np.int32).copy()
+        )
+        # Chain-position offset per creator: a frame root with
+        # Root.index = k means the creator's next event has Go index
+        # k+1 but chain position 0 (reference hashgraph.go:879-898).
+        self.index_base = (
+            np.zeros(n, np.int32) if index_base is None
+            else np.asarray(index_base, np.int32).copy()
         )
         self.rho_min = int(self.root_round.min()) + 1
         self.cap = max(_pow2(capacity, block), block)
@@ -455,11 +470,19 @@ class IncrementalEngine:
         self._chain_len_prev = np.zeros(n, np.int32)
 
         # Fame / round-received bookkeeping (reference
-        # hashgraph.go:629-637: queued-once, removed-once).
+        # hashgraph.go:629-637: queued-once, removed-once). A fresh
+        # graph starts with round 0 queued (reference hashgraph.go
+        # NewHashgraph); a frame-reset graph starts empty (the host
+        # mirror's reset() clears the list) and re-queues rounds as
+        # replayed events land.
         self.famous = np.zeros((0, n), np.int32)  # [r_total, n] trilean
-        self.undecided_rounds: List[int] = [0]
-        self._queued_rounds = {0}
-        self._prev_first_undec = 0
+        if from_reset:
+            self.undecided_rounds: List[int] = []
+            self._queued_rounds: set = set()
+        else:
+            self.undecided_rounds = [0]
+            self._queued_rounds = {0}
+        self._prev_first_undec = self.rho_min
         self._last_growth = 8  # rounds added by the previous run
         self._last_newly = 64  # round-received burst size of the last run
         self.last_consensus_round: Optional[int] = None
@@ -478,12 +501,15 @@ class IncrementalEngine:
     def append(self, sp: int, op: int, creator: int, index: int,
                coin: bool, ts_ns: int) -> int:
         """Append one event; parents are engine ids (-1 = root). Returns
-        the event id. Enforces the reference's insert discipline: index
-        must extend the creator's chain contiguously (fork/foreign
-        events are rejected upstream, hashgraph.go:404-445)."""
+        the event id. `index` is the event's Go index; the engine works
+        in chain positions (index - index_base[creator]). Enforces the
+        reference's insert discipline: index must extend the creator's
+        chain contiguously (fork/foreign events are rejected upstream,
+        hashgraph.go:404-445)."""
+        index = index - int(self.index_base[creator])
         if index != int(self.chain_len[creator]):
             raise ValueError(
-                f"non-contiguous index {index} for creator {creator} "
+                f"non-contiguous position {index} for creator {creator} "
                 f"(chain length {int(self.chain_len[creator])})"
             )
         expect_sp = self.chain[creator, index - 1] if index > 0 else -1
@@ -762,9 +788,15 @@ class IncrementalEngine:
         # straggler batch (i0 below the known rounds) costs one redo
         # dispatch, never correctness.
         growth = 2 * self._last_growth + 2
+        # Empty-queue fallback: _prev_first_undec, NOT beyond the table —
+        # an empty list means either a fresh reset (first undecided round
+        # is rho_min) or a fixpoint (= r_total); in both cases rounds
+        # discovered THIS run must land inside the fame window so fame
+        # is decided in the same call, like the host's
+        # divide_rounds->decide_fame sequence.
         rx0_known = (
             self.undecided_rounds[0]
-            if self.undecided_rounds else self.rho_min + rel_rows)
+            if self.undecided_rounds else self._prev_first_undec)
         i0_known = min(self._prev_first_undec, rx0_known)
         rw = _pow2(max(self.rho_min + rel_rows - rx0_known, 1) + growth)
         iw = _pow2(max(self.rho_min + rel_rows - i0_known, 1) + growth)
@@ -792,9 +824,7 @@ class IncrementalEngine:
                 rho = self.rho_min + t
                 fam_rel[t] = self.famous[rho]
                 in_list_rel[t] = rho in undecided_set
-            rx0 = (
-                self.undecided_rounds[0]
-                if self.undecided_rounds else self.rho_min + rcap)
+            rx0 = rx0_known
             packed = np.asarray(_consensus_fused(
                 self._chain_la, self._chain_rb, chain_len_d, la, fd, rb,
                 self._chain_d, jnp.asarray(wt_tab), jnp.asarray(fr_tab),
