@@ -1,26 +1,38 @@
-"""Multi-chip consensus: the full pipeline sharded over a device
-mesh — the layout SURVEY.md §5 prescribes (shard the event axis, all-
-gather coordinate rows for cross-shard stronglySee), applied to every
-stage of the real pipeline rather than a demo reduction:
+"""Multi-chip consensus with MEMORY sharding: d chips hold a d× DAG.
 
-  coordinates   wavefront level slots sharded over devices; each level's
-                freshly-computed lastAncestor rows are all-gathered so
-                the replicated coordinate table stays consistent
-                (collective: one all_gather of [W/d, n] per level, ICI)
-  fd            creator chains sharded; each device owns the
-                first-descendant columns of its chains, all-gathered
-                into the replicated [E, n] table
-  rounds        same level sharding as coordinates; the per-level
-                witness-table update is all-gathered and applied
-                identically on every device (within a level, each
-                creator contributes at most one witness, so the merged
-                scatter is conflict-free)
-  fame          voting witnesses sharded; per voting round the vote
-                tensor slices are all-gathered (votes of round j-1 feed
-                every device's MXU tally) and decisions are psum-reduced
-  round recv    pure event-axis sharding — each device decides round
-                received and median timestamps for its event block
-                against replicated witness tables; no collective at all
+The pipeline shards the two O(E·n) tables — lastAncestor coordinates
+and first descendants — across the mesh and keeps them sharded through
+every stage; nothing event-sized is ever replicated except O(E) int32
+vectors (parents, creators, rounds). This is the layout SURVEY.md §5
+prescribes (shard the event axis, all-gathers for cross-shard
+stronglySee), taken to its conclusion: the collectives move rows, the
+resident state never un-shards.
+
+  coordinates   chain-sharded [n/d, K, n]: device p owns the coordinate
+                rows of its creators' chains. The wavefront sweep
+                computes each level replicated (cheap [W, n] maxes) from
+                parent rows fetched by masked-contribution + pmax (one
+                [2W, n] collective per level) and each device writes
+                back only its own creators' rows.
+  fd            ranks are a pure chain-local compare-and-count (no
+                collective at all — each device counts descendants on
+                its own chains), then one all_to_all transposes the
+                [E, n/d] chain columns into the event-sharded [E/d, n]
+                table the round-received stage consumes.
+  rounds        per level the candidate-witness strongly-see tally is
+                sharded over candidate chains ([W, n/d, n] compares per
+                device) and psum-reduced to the [W] count; witness
+                coordinate/fd rows are accumulated into a chain-sharded
+                [r, n/d, n] table as witnesses are discovered.
+  fame          voting witnesses sharded exactly as before, but reading
+                the prefetched [r_small, n, n] witness-row tables
+                (bounded by rounds·n², not E·n) instead of replicated
+                event tables; votes all-gathered per round, decisions
+                psum-reduced.
+  round recv    pure event-axis sharding: each device owns its block of
+                the event-sharded fd table and decides round received +
+                median timestamps against the replicated witness-row
+                tables; no collective at all.
 
 Every stage reproduces the single-device kernels bit-for-bit (asserted
 by tests/test_sharded.py and the driver's dryrun_multichip). Semantics
@@ -51,15 +63,6 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .kernels import FAME_TRUE, FAME_FALSE, FAME_UNDEFINED, INT32_MAX, ZERO_TS_RANK
 
 
-def _pad_axis(a: np.ndarray, axis: int, mult: int, fill) -> np.ndarray:
-    pad = (-a.shape[axis]) % mult
-    if not pad:
-        return a
-    widths = [(0, 0)] * a.ndim
-    widths[axis] = (0, pad)
-    return np.pad(a, widths, constant_values=fill)
-
-
 def _axis_size(mesh: Mesh, axis: MeshAxis) -> int:
     names = (axis,) if isinstance(axis, str) else tuple(axis)
     d = 1
@@ -68,49 +71,106 @@ def _axis_size(mesh: Mesh, axis: MeshAxis) -> int:
     return d
 
 
+def _axis_names(axis: MeshAxis) -> Tuple[str, ...]:
+    return (axis,) if isinstance(axis, str) else tuple(axis)
+
+
+def _make_axis_index(mesh: Mesh, axis: MeshAxis):
+    """Combined shard index along a (possibly composite) axis, matching
+    shard_map's P((a, b)) partition order (a-major)."""
+    names = _axis_names(axis)
+    sizes = [mesh.shape[a] for a in names]
+
+    def axis_index():
+        idx = jnp.int32(0)
+        for a, s in zip(names, sizes):
+            idx = idx * s + lax.axis_index(a)
+        return idx
+
+    return axis_index
+
+
 def _sharded(mesh, fn, in_specs, out_specs):
     return jax.jit(shard_map(
         fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False))
 
 
-# -- stage 1: lastAncestors, level slots sharded -------------------------
+# -- remote row fetches ---------------------------------------------------
+#
+# The resident tables are sharded; a row read is a masked local gather
+# (the owner contributes the row, everyone else -1) followed by a pmax
+# over the mesh axis. All real values are >= -1 (coordinates) or >= 0
+# (fd ranks / INT32_MAX), so max-reduce with a -1 fill is exact.
 
 
-def make_last_ancestors(mesh: Mesh, *, n: int, axis: MeshAxis = "sp"):
-    def la_sweep(self_parent, other_parent, creator, index, levels_loc):
-        e = self_parent.shape[0] - 1
-        w_loc = levels_loc.shape[1]
-        la = jnp.full((e + 1, n), -1, dtype=jnp.int32)
-        rows_iota = jnp.arange(w_loc)
+def _fetch_by_chain(la_cs, cr, pos, off, n_loc, fill=-1):
+    """Rows keyed by (creator, chain position) from the chain-sharded
+    [n_loc, K, n] table. cr/pos: [m]; invalid entries (cr or pos < 0)
+    fetch fill rows. Returns the LOCAL contribution [m, n]."""
+    k = la_cs.shape[1]
+    owned = (cr >= off) & (cr < off + n_loc) & (pos >= 0) & (pos < k)
+    c_idx = jnp.clip(cr - off, 0, n_loc - 1)
+    p_idx = jnp.clip(pos, 0, k - 1)
+    return jnp.where(owned[:, None], la_cs[c_idx, p_idx], fill)
 
-        def step(l, la):
-            ids = levels_loc[l]  # [W/d] local slot slice
+
+def _fetch_by_event(tbl_loc, ids, off, e_loc, fill=-1):
+    """Rows keyed by event id from the event-sharded [e_loc, n] table.
+    ids: [m]; invalid ids (< 0) fetch fill rows. Returns the LOCAL
+    contribution [m, n]."""
+    owned = (ids >= off) & (ids < off + e_loc)
+    return jnp.where(
+        owned[:, None], tbl_loc[jnp.clip(ids - off, 0, e_loc - 1)], fill)
+
+
+# -- stage 1: lastAncestors, chain-sharded storage ------------------------
+
+
+def make_last_ancestors(mesh: Mesh, *, n: int, k: int, axis: MeshAxis = "sp"):
+    d = _axis_size(mesh, axis)
+    if n % d:
+        raise ValueError(f"participants {n} must divide over {d} devices")
+    n_loc = n // d
+    axis_index = _make_axis_index(mesh, axis)
+
+    def la_sweep(self_parent, other_parent, creator, index, levels):
+        w = levels.shape[1]
+        la_cs = jnp.full((n_loc, k, n), -1, jnp.int32)
+        off = axis_index() * n_loc
+        rows_iota = jnp.arange(w)
+
+        def step(l, la_cs):
+            ids = levels[l]  # [W] replicated
             valid = ids >= 0
-            sids = jnp.where(valid, ids, e)
-            sp = self_parent[sids]
-            op = other_parent[sids]
-            sp_rows = jnp.where(
-                (sp >= 0)[:, None], la[jnp.where(sp >= 0, sp, e)], -1)
-            op_rows = jnp.where(
-                (op >= 0)[:, None], la[jnp.where(op >= 0, op, e)], -1)
-            rows = jnp.maximum(sp_rows, op_rows)
-            rows = rows.at[rows_iota, creator[sids]].set(index[sids])
-            rows = jnp.where(valid[:, None], rows, -1)
-            # Cross-shard consistency: everyone applies the full level.
-            sids_all = lax.all_gather(sids, axis, axis=0, tiled=True)
-            rows_all = lax.all_gather(rows, axis, axis=0, tiled=True)
-            return la.at[sids_all].set(rows_all)
+            sids = jnp.where(valid, ids, 0)
+            sp = jnp.where(valid, self_parent[sids], -1)
+            op = jnp.where(valid, other_parent[sids], -1)
+            # Parent rows by (creator, position); one fused collective.
+            both = jnp.concatenate([sp, op])
+            safe = jnp.where(both >= 0, both, 0)
+            cr_p = jnp.where(both >= 0, creator[safe], -1)
+            pos_p = jnp.where(both >= 0, index[safe], -1)
+            contrib = _fetch_by_chain(la_cs, cr_p, pos_p, off, n_loc)
+            rows2 = lax.pmax(contrib, axis)  # [2W, n] replicated
+            rows = jnp.maximum(rows2[:w], rows2[w:])
+            cr_e = jnp.where(valid, creator[sids], -1)
+            idx_e = index[sids]
+            rows = rows.at[rows_iota, jnp.clip(cr_e, 0, n - 1)].set(
+                jnp.where(valid, idx_e, -1))
+            # Write back only this shard's creators (OOB lanes drop).
+            owned = valid & (cr_e >= off) & (cr_e < off + n_loc)
+            c_idx = jnp.where(owned, cr_e - off, n_loc)
+            p_idx = jnp.where(owned, idx_e, k)
+            return la_cs.at[c_idx, p_idx].set(rows, mode="drop")
 
-        la = lax.fori_loop(0, levels_loc.shape[0], step, la)
-        return la[:e]
+        return lax.fori_loop(0, levels.shape[0], step, la_cs)
 
     return _sharded(
-        mesh, la_sweep,
-        (P(), P(), P(), P(), P(None, axis)), P())
+        mesh, la_sweep, (P(), P(), P(), P(), P()), P(axis))
 
 
-# -- stage 2: first descendants, chains sharded --------------------------
+# -- stage 2: first descendants, chain-local ranks + one all_to_all ------
 
 
 def make_first_descendants(mesh: Mesh, *, n: int, axis: MeshAxis = "sp"):
@@ -118,95 +178,177 @@ def make_first_descendants(mesh: Mesh, *, n: int, axis: MeshAxis = "sp"):
     if n % d:
         raise ValueError(f"participants {n} must divide over {d} devices")
 
-    def fd_cols(la, creator, index, chain_loc, chain_len_loc):
-        e = la.shape[0]
-        k = chain_loc.shape[1]
-        chain_valid = chain_loc >= 0
-        chain_la = jnp.where(
-            chain_valid[:, :, None],
-            la[jnp.where(chain_valid, chain_loc, 0)], INT32_MAX)
-        tc = min(max((1 << 27) // max((n // d) * n * k, 1), 1), k)
+    def fd_cols(la_cs, chain_len_loc, creator, index):
+        # la_cs: [n/d, K, n] local chains' coordinate rows. Ranks are a
+        # chain-local compare-and-count — zero communication.
+        e_pad = creator.shape[0] - 1
+        k = la_cs.shape[1]
+        n_loc = la_cs.shape[0]
+        tc = min(max((1 << 27) // max(n_loc * n * k, 1), 1), k)
         nchunks = (k + tc - 1) // tc
-        k_pad = nchunks * tc
+        k_cap = nchunks * tc
+        # Positions beyond a chain's end carry the storage fill (-1) and
+        # must not count (the one-shot kernel's chain_la uses INT32_MAX
+        # there, kernels.first_descendant_cube).
+        in_chain = (jnp.arange(k)[None, :] < chain_len_loc[:, None])
 
         def tchunk(g, acc):
             t0 = g * tc
             ts = t0 + jnp.arange(tc, dtype=jnp.int32)
-            cnt = (chain_la[:, :, :, None] < ts[None, None, None, :]).sum(
-                1, dtype=jnp.int32)
+            cnt = (
+                (la_cs[:, :, :, None] < ts[None, None, None, :])
+                & in_chain[:, :, None, None]
+            ).sum(1, dtype=jnp.int32)
             return lax.dynamic_update_slice(acc, cnt, (0, 0, t0))
 
         ranks = lax.fori_loop(
             0, nchunks, tchunk,
-            jnp.zeros((n // d, n, k_pad), dtype=jnp.int32))[:, :, :k]
+            jnp.zeros((n_loc, n, k_cap), dtype=jnp.int32))[:, :, :k]
         cube = jnp.where(ranks < chain_len_loc[:, None, None], ranks,
                          INT32_MAX)
-        ca = creator[:e]
-        ia = jnp.clip(index[:e], 0, k - 1)
-        fd_part = cube[:, ca, ia].T  # [E, n/d] local chain columns
-        fd_part = jnp.where((index[:e] >= 0)[:, None], fd_part, INT32_MAX)
-        return lax.all_gather(fd_part, axis, axis=1, tiled=True)  # [E, n]
+        ca = creator[:e_pad]
+        ia = jnp.clip(index[:e_pad], 0, k - 1)
+        fd_part = cube[:, ca, ia].T  # [E_pad, n/d] local chain columns
+        fd_part = jnp.where(
+            (index[:e_pad] >= 0)[:, None], fd_part, INT32_MAX)
+        # Transpose chain-sharded columns into event-sharded rows.
+        return lax.all_to_all(
+            fd_part, axis, split_axis=0, concat_axis=1, tiled=True)
 
     return _sharded(
-        mesh, fd_cols, (P(), P(), P(), P(axis), P(axis)), P())
+        mesh, fd_cols,
+        (P(axis), P(axis), P(), P()),
+        P(axis))
 
 
-# -- stage 3: rounds + witness table, level slots sharded ----------------
+# -- stage 3: rounds + witness tables, candidate tally chain-sharded -----
 
 
 def make_rounds(mesh: Mesh, *, n: int, sm: int, r: int, axis: MeshAxis = "sp"):
-    def rounds_sweep(self_parent, other_parent, creator, index, la, fd,
-                     levels_loc, root_round):
-        e = la.shape[0]
-        w_loc = levels_loc.shape[1]
-        la_p = jnp.concatenate([la, jnp.full((1, n), -1, jnp.int32)], axis=0)
-        rounds = jnp.full((e + 1,), -1, dtype=jnp.int32)
-        wit = jnp.zeros((e + 1,), dtype=jnp.bool_)
+    d = _axis_size(mesh, axis)
+    if n % d:
+        raise ValueError(f"participants {n} must divide over {d} devices")
+    n_loc = n // d
+    axis_index = _make_axis_index(mesh, axis)
+
+    def rounds_sweep(self_parent, other_parent, creator, index, levels,
+                     root_round, la_cs, fd_es):
+        e_pad = self_parent.shape[0] - 1
+        e_loc = fd_es.shape[0]
+        w = levels.shape[1]
+        off = axis_index() * n_loc
+        off_e = axis_index() * e_loc
+        rounds = jnp.full((e_pad + 1,), -1, dtype=jnp.int32)
+        wit = jnp.zeros((e_pad + 1,), dtype=jnp.bool_)
         wt = jnp.full((r + 1, n), -1, dtype=jnp.int32)
+        # Chain-sharded fd rows of discovered witnesses: the candidate
+        # tally below reads them per level without re-fetching.
+        fd_wt = jnp.full((r + 1, n_loc, n), INT32_MAX, jnp.int32)
 
         def step(l, carry):
-            rounds, wit, wt = carry
-            ids = levels_loc[l]
+            rounds, wit, wt, fd_wt = carry
+            ids = levels[l]
             valid = ids >= 0
-            sids = jnp.where(valid, ids, e)
-            sp = self_parent[sids]
-            op = other_parent[sids]
-            cr = creator[sids]
-            rnd_sp_raw = jnp.where(sp >= 0, rounds[jnp.where(sp >= 0, sp, e)], -1)
+            sids = jnp.where(valid, ids, 0)
+            sp = jnp.where(valid, self_parent[sids], -1)
+            op = jnp.where(valid, other_parent[sids], -1)
+            cr = jnp.where(valid, creator[sids], 0)
+            rnd_sp_raw = jnp.where(
+                sp >= 0, rounds[jnp.where(sp >= 0, sp, 0)], -1)
             sp_round = jnp.where(sp >= 0, rnd_sp_raw, root_round[cr])
             op_round = jnp.where(
-                op >= 0, rounds[jnp.where(op >= 0, op, e)], root_round[cr])
+                op >= 0, rounds[jnp.where(op >= 0, op, 0)], root_round[cr])
             use_op = sp_round < op_round
             pr = jnp.where(use_op, op_round, sp_round)
             pr_root = jnp.where(use_op, op < 0, sp < 0)
-            cand = wt[jnp.clip(pr, 0, r - 1)]  # [W/d, n]
+
+            # lastAncestor rows of the level's events (one collective).
+            pos_e = index[sids]
+            la_x = lax.pmax(
+                _fetch_by_chain(la_cs, jnp.where(valid, cr, -1), pos_e,
+                                off, n_loc), axis)  # [W, n]
+
+            # Candidate strongly-see tally, sharded over the candidate
+            # chains: device p compares against fd rows of ITS creators'
+            # candidate witnesses and the counts psum to the full tally.
+            pr_c = jnp.clip(pr, 0, r - 1)
+            cand = wt[pr_c]  # [W, n] replicated table
             cand_valid = cand >= 0
-            fd_c = fd[jnp.where(cand_valid, cand, 0)]  # [W/d, n, n]
-            la_x = la_p[sids]
-            ss = ((la_x[:, None, :] >= fd_c).sum(-1) >= sm) & cand_valid
-            inc = pr_root | (ss.sum(-1) >= sm)
+            fd_c_loc = fd_wt[pr_c]  # [W, n/d, n] local witness fd rows
+            ss_loc = (la_x[:, None, :] >= fd_c_loc).sum(-1) >= sm
+            # Mask to valid candidates in this shard's columns.
+            ss_loc = ss_loc & _slice_cols(cand_valid, off, n_loc)
+            cnt = lax.psum(ss_loc.sum(-1, dtype=jnp.int32), axis)  # [W]
+
+            inc = pr_root | (cnt >= sm)
             r_new = pr + inc.astype(jnp.int32)
             w_new = ((sp < 0) & (op < 0)) | (r_new > rnd_sp_raw)
-            # All-gather the level and apply identically everywhere.
-            sids_all = lax.all_gather(sids, axis, axis=0, tiled=True)
-            valid_all = lax.all_gather(valid, axis, axis=0, tiled=True)
-            r_all = lax.all_gather(r_new, axis, axis=0, tiled=True)
-            w_all = lax.all_gather(w_new, axis, axis=0, tiled=True)
-            cr_all = creator[sids_all]
-            rounds = rounds.at[sids_all].set(jnp.where(valid_all, r_all, -1))
-            wit = wit.at[sids_all].set(jnp.where(valid_all, w_all, False))
-            upd = valid_all & w_all
-            r_idx = jnp.where(upd, jnp.clip(r_all, 0, r - 1), r)
-            wt = wt.at[r_idx, cr_all].set(jnp.where(upd, sids_all, -1))
-            return rounds, wit, wt
 
-        rounds, wit, wt = lax.fori_loop(
-            0, levels_loc.shape[0], step, (rounds, wit, wt))
-        return rounds[:e], wit[:e], wt[:r]
+            rounds = rounds.at[jnp.where(valid, sids, e_pad)].set(
+                jnp.where(valid, r_new, -1), mode="drop")
+            wit = wit.at[jnp.where(valid, sids, e_pad)].set(
+                jnp.where(valid, w_new, False), mode="drop")
+            upd = valid & w_new
+            r_idx = jnp.where(upd, jnp.clip(r_new, 0, r - 1), r)
+            wt = wt.at[r_idx, cr].set(jnp.where(upd, sids, -1))
+
+            # fd rows of the new witnesses (one collective), written
+            # into this shard's creator rows of the witness-fd table.
+            fd_rows = lax.pmax(
+                _fetch_by_event(fd_es, jnp.where(upd, sids, -1), off_e,
+                                e_loc), axis)  # [W, n]
+            owned = upd & (cr >= off) & (cr < off + n_loc)
+            fd_wt = fd_wt.at[
+                jnp.where(owned, r_idx, r), jnp.where(owned, cr - off, n_loc)
+            ].set(fd_rows, mode="drop")
+            return rounds, wit, wt, fd_wt
+
+        rounds, wit, wt, fd_wt = lax.fori_loop(
+            0, levels.shape[0], step, (rounds, wit, wt, fd_wt))
+        return rounds[:e_pad], wit[:e_pad], wt[:r]
 
     return _sharded(
         mesh, rounds_sweep,
-        (P(), P(), P(), P(), P(), P(), P(None, axis), P()), (P(), P(), P()))
+        (P(), P(), P(), P(), P(), P(), P(axis),
+         P(axis)),
+        (P(), P(), P()))
+
+
+def _slice_cols(a, off, n_loc):
+    """a[:, off:off+n_loc] with a traced offset."""
+    return lax.dynamic_slice_in_dim(a, off, n_loc, axis=1)
+
+
+# -- witness-row prefetch -------------------------------------------------
+
+
+def make_wt_tables(mesh: Mesh, *, n: int, axis: MeshAxis = "sp"):
+    """Fetch the lastAncestor and fd rows of every witness into
+    replicated [r_small·n, n] tables — the only row tables the fame and
+    round-received stages need, bounded by rounds·n², not E·n."""
+    d = _axis_size(mesh, axis)
+    if n % d:
+        raise ValueError(f"participants {n} must divide over {d} devices")
+    n_loc = n // d
+    axis_index = _make_axis_index(mesh, axis)
+
+    def fetch(wt_flat, creator, index, la_cs, fd_es):
+        e_loc = fd_es.shape[0]
+        off = axis_index() * n_loc
+        off_e = axis_index() * e_loc
+        safe = jnp.where(wt_flat >= 0, wt_flat, 0)
+        cr = jnp.where(wt_flat >= 0, creator[safe], -1)
+        pos = jnp.where(wt_flat >= 0, index[safe], -1)
+        la_rows = lax.pmax(
+            _fetch_by_chain(la_cs, cr, pos, off, n_loc), axis)
+        fd_rows = lax.pmax(
+            _fetch_by_event(fd_es, wt_flat, off_e, e_loc), axis)
+        return la_rows, fd_rows
+
+    return _sharded(
+        mesh, fetch,
+        (P(), P(), P(), P(axis), P(axis)),
+        (P(), P()))
 
 
 # -- stage 4: fame, voting witnesses sharded -----------------------------
@@ -218,7 +360,10 @@ def make_fame(mesh: Mesh, *, n: int, sm: int, r: int, axis: MeshAxis = "sp"):
         raise ValueError(f"participants {n} must divide over {d} devices")
     n_loc = n // d
 
-    def fame_sweep(wt, la, fd, index, coin, y_off):
+    def fame_sweep(wt, la_wt, fd_wt, index, coin, y_off):
+        # la_wt/fd_wt: [r, n, n] replicated witness rows (row (j, c) =
+        # the coordinate/fd row of witness wt[j, c]; -1 rows for absent
+        # witnesses are masked by wt validity below).
         wt_valid = wt >= 0
         wt_safe = jnp.where(wt_valid, wt, 0)
         idx_x = jnp.where(wt_valid, index[wt_safe], -1)  # [r, n]
@@ -231,11 +376,11 @@ def make_fame(mesh: Mesh, *, n: int, sm: int, r: int, axis: MeshAxis = "sp"):
             y = lax.dynamic_slice(wt[j], (y_off[0],), (n_loc,))
             y_valid = y >= 0
             ys = jnp.where(y_valid, y, 0)
-            la_y = la[ys]  # [n/d, n]
+            la_y = lax.dynamic_slice(
+                la_wt[j], (y_off[0], 0), (n_loc, n))  # [n/d, n]
             see_v = la_y[:, None, :] >= idx_x[None, :, :]
-            wp = wt[j - 1]
-            wp_valid = wp >= 0
-            fd_p = fd[jnp.where(wp_valid, wp, 0)]  # [n, n]
+            wp_valid = wt[j - 1] >= 0
+            fd_p = fd_wt[j - 1]  # [n, n]
             ss = ((la_y[:, None, :] >= fd_p[None, :, :]).sum(-1) >= sm)
             ss = ss & wp_valid[None, :]
             # Round j-1's votes by ALL voters feed the tally.
@@ -272,19 +417,19 @@ def make_fame(mesh: Mesh, *, n: int, sm: int, r: int, axis: MeshAxis = "sp"):
         return famous
 
     return _sharded(
-        mesh, fame_sweep, (P(), P(), P(), P(), P(), P(axis)), P())
+        mesh, fame_sweep,
+        (P(), P(), P(), P(), P(), P(axis)), P())
 
 
 # -- stage 5: round received, pure event sharding ------------------------
 
 
 def make_round_received(mesh: Mesh, *, n: int, r: int, axis: MeshAxis = "sp"):
-    def rr_block(rounds_loc, la_loc, fd_loc, creator_loc, index_loc,
+    def rr_block(rounds_loc, fd_loc, creator_loc, index_loc,
                  wt, famous, idx_w, la_wt, chain_rank, valid_loc):
         e_loc = rounds_loc.shape[0]
         k = chain_rank.shape[1]
         wt_valid = wt >= 0
-        wt_safe = jnp.where(wt_valid, wt, 0)
         has_undec = ((famous == FAME_UNDEFINED) & wt_valid).any(1)
         min_undec = jnp.min(jnp.where(has_undec, jnp.arange(r), r))
         fmask = (famous == FAME_TRUE) & wt_valid
@@ -322,11 +467,11 @@ def make_round_received(mesh: Mesh, *, n: int, r: int, axis: MeshAxis = "sp"):
         cts = jnp.where(rr >= 0, med, ZERO_TS_RANK)
         return rr, cts
 
+    a = axis
     return _sharded(
         mesh, rr_block,
-        (P(axis), P(axis), P(axis), P(axis), P(axis), P(), P(), P(), P(),
-         P(), P(axis)),
-        (P(axis), P(axis)))
+        (P(a), P(a), P(a), P(a), P(), P(), P(), P(), P(), P(a)),
+        (P(a), P(a)))
 
 
 # -- driver --------------------------------------------------------------
@@ -337,57 +482,70 @@ def sharded_pipeline(dag, mesh: Mesh, axis: MeshAxis = "sp") -> Tuple:
     `axis` (a mesh axis name or tuple of names for multi-host
     hierarchies). Output contract matches pipeline.run_pipeline — and
     matches it bit-for-bit (the parity oracle for the multi-chip
-    path)."""
+    path). The O(E·n) state stays sharded end to end, so d devices
+    hold a d× larger DAG than one device can."""
     d = _axis_size(mesh, axis)
     n, e, sm = dag.n, dag.e, dag.super_majority
     r = dag.max_rounds
+    if n % d:
+        raise ValueError(f"participants {n} must divide over {d} devices")
+    k = dag.chain.shape[1]
+    e_pad = ((e + d - 1) // d) * d if e else d
 
-    levels = _pad_axis(dag.levels, 1, d, -1)
-    la_f = make_last_ancestors(mesh, n=n, axis=axis)
-    la = la_f(dag.self_parent, dag.other_parent, dag.creator, dag.index,
-              levels)
+    def padded(a, fill):
+        out = np.full(e_pad + 1, fill, np.int32)
+        out[:e] = np.asarray(a)[:e]
+        return jnp.asarray(out)
+
+    sp_p = padded(dag.self_parent, -1)
+    op_p = padded(dag.other_parent, -1)
+    cr_p = padded(dag.creator, 0)
+    idx_p = padded(dag.index, -1)
+
+    la_f = make_last_ancestors(mesh, n=n, k=k, axis=axis)
+    la_cs = la_f(sp_p, op_p, cr_p, idx_p, jnp.asarray(dag.levels))
 
     fd_f = make_first_descendants(mesh, n=n, axis=axis)
-    fd = fd_f(la, dag.creator, dag.index, dag.chain, dag.chain_len)
+    fd_es = fd_f(la_cs, jnp.asarray(dag.chain_len), cr_p, idx_p)
 
     rounds_f = make_rounds(mesh, n=n, sm=sm, r=r, axis=axis)
-    rounds, wit, wt = rounds_f(
-        dag.self_parent, dag.other_parent, dag.creator, dag.index, la, fd,
-        levels, dag.root_round)
+    rounds_p, wit_p, wt = rounds_f(
+        sp_p, op_p, cr_p, idx_p, jnp.asarray(dag.levels),
+        jnp.asarray(dag.root_round), la_cs, fd_es)
+    rounds = np.asarray(rounds_p)[:e]
+    wit = np.asarray(wit_p)[:e]
 
     from .pipeline import pad_famous, tight_round_bucket
 
     r_small = tight_round_bucket(rounds if e else np.zeros(0), r)
     wt_small = np.asarray(wt[:r_small])
+
+    # Witness-row tables: the only row state fame / round-received
+    # need, fetched once from the sharded tables.
+    fetch_f = make_wt_tables(mesh, n=n, axis=axis)
+    la_rows, fd_rows = fetch_f(
+        jnp.asarray(wt_small.ravel()), cr_p, idx_p, la_cs, fd_es)
+    la_wt = la_rows.reshape(r_small, n, n)
+    fd_wt = fd_rows.reshape(r_small, n, n)
+
     y_off = np.arange(0, n, n // d, dtype=np.int32)
     fame_f = make_fame(mesh, n=n, sm=sm, r=r_small, axis=axis)
-    famous_small = fame_f(jnp.asarray(wt_small), la, fd, dag.index, dag.coin,
-                          jnp.asarray(y_off))
+    famous_small = fame_f(jnp.asarray(wt_small), la_wt, fd_wt,
+                          idx_p, jnp.asarray(dag.coin), jnp.asarray(y_off))
 
-    # Replicated witness-row tables for the event-sharded rr stage.
     wt_valid = wt_small >= 0
     wt_safe = np.where(wt_valid, wt_small, 0)
-    la_np = np.asarray(la)
     idx_w = np.where(wt_valid, np.asarray(dag.index)[wt_safe], -1)
-    la_wt = la_np[wt_safe]  # [r_small, n, n]
 
-    e_pad = ((e + d - 1) // d) * d
-    pad = e_pad - e
-
-    def padded(a, fill):
-        return np.pad(np.asarray(a)[:e], (0, pad), constant_values=fill)
-
+    rounds_pad = jnp.asarray(
+        np.pad(rounds, (0, e_pad - e), constant_values=0))
     rr_f = make_round_received(mesh, n=n, r=r_small, axis=axis)
     rr_p, cts_p = rr_f(
-        jnp.asarray(padded(rounds, 0)),
-        jnp.asarray(_pad_axis(la_np[:e], 0, d, -1)),
-        jnp.asarray(_pad_axis(np.asarray(fd)[:e], 0, d, INT32_MAX)),
-        jnp.asarray(padded(dag.creator, 0)),
-        jnp.asarray(padded(dag.index, 0)),
+        rounds_pad, fd_es, cr_p[:e_pad], idx_p[:e_pad],
         jnp.asarray(wt_small), famous_small, jnp.asarray(idx_w),
-        jnp.asarray(la_wt), jnp.asarray(dag.chain_rank),
+        la_wt, jnp.asarray(dag.chain_rank),
         jnp.asarray(np.arange(e_pad) < e))
     rr = np.asarray(rr_p)[:e]
     cts = np.asarray(cts_p)[:e]
 
-    return rounds, wit, wt, pad_famous(famous_small, r, n), rr, cts
+    return rounds, wit, np.asarray(wt), pad_famous(famous_small, r, n), rr, cts

@@ -31,8 +31,9 @@ def _mesh(shape):
 
 @pytest.mark.parametrize(
     "n,e,shape",
-    [(8, 400, "1d"), (16, 1000, "1d"), (8, 480, "2d")],
-    ids=["n8", "n16", "n8-dcn-ici"],
+    [(8, 400, "1d"), (16, 1000, "1d"), (64, 5000, "1d"), (8, 480, "2d"),
+     (64, 5000, "2d")],
+    ids=["n8", "n16", "n64-e5000", "n8-dcn-ici", "n64-dcn-ici"],
 )
 def test_sharded_matches_single_device(n, e, shape):
     mesh, axis = _mesh(shape)
