@@ -60,6 +60,21 @@ def cmd_run(args) -> int:
     )
     logger = logging.getLogger("babble_tpu")
 
+    if args.engine == "tpu":
+        # Persistent XLA compile cache: a restarting node (and every
+        # node of a localhost testnet) reuses compiled consensus
+        # kernels instead of paying tens of seconds of recompiles.
+        import jax
+
+        cache_dir = os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(
+                os.path.expanduser("~"), ".cache", "babble_tpu", "jax"))
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0)
+
     datadir = args.datadir
     key = PemKey(datadir).read_key()
     peers = sort_peers_by_pub_key(JSONPeers(datadir).peers())
@@ -80,6 +95,7 @@ def cmd_run(args) -> int:
         store_type=args.store,
         store_path=args.store_path or os.path.join(datadir, "store.db"),
         engine=args.engine,
+        engine_mesh=args.engine_mesh,
         consensus_interval=(
             args.consensus_interval / 1000.0
             if args.consensus_interval is not None
@@ -168,6 +184,11 @@ def build_parser() -> argparse.ArgumentParser:
     rn.add_argument("--engine", default="host", choices=["host", "tpu"],
                     help="consensus engine: reference-semantics host "
                          "driver or the batched device pipeline")
+    rn.add_argument("--engine_mesh", type=int, default=0,
+                    help="devices for the tpu engine's resident state "
+                         "(0/1 = single device; d > 1 shards the "
+                         "O(E*n) carries over a d-device mesh so DAG "
+                         "capacity scales with local chips)")
     rn.add_argument("--consensus_interval", type=int, default=None,
                     help="min milliseconds between consensus passes "
                          "(0 = after every sync, the reference cadence; "

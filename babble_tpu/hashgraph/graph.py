@@ -623,7 +623,10 @@ class Hashgraph:
         timestamps.sort(key=lambda t: t.ns)
         return timestamps[len(timestamps) // 2]
 
-    def run_consensus(self) -> None:
+    def run_consensus(self, unlocked=None) -> None:
+        # `unlocked` is the device engine's lock-release seam
+        # (tpu_graph.py); the host pipeline has no blocking device wait
+        # to release around.
         self.divide_rounds()
         self.decide_fame()
         self.find_order()

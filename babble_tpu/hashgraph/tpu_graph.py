@@ -47,12 +47,19 @@ class TpuHashgraph(Hashgraph):
         *,
         capacity: int = 256,
         block: int = 256,
+        k_capacity: int = 64,
+        mesh=None,
+        mesh_axis: str = "sp",
     ):
         super().__init__(participants, store, commit_callback)
         self._capacity = capacity
         self._block = block
+        self._k_capacity = k_capacity
+        self._mesh = mesh
+        self._mesh_axis = mesh_axis
         self.engine = IncrementalEngine(
-            len(participants), capacity=capacity, block=block)
+            len(participants), capacity=capacity, block=block,
+            k_capacity=k_capacity, mesh=mesh, mesh_axis=mesh_axis)
         self._eid_of: Dict[str, int] = {}
         # eid -> hex only; Event objects stay in the Store so its cache
         # bound (not this map) governs host memory.
@@ -97,8 +104,8 @@ class TpuHashgraph(Hashgraph):
 
     # -- consensus: one device pipeline call + Store mirroring --------------
 
-    def run_consensus(self) -> None:
-        delta = self.engine.run()
+    def run_consensus(self, unlocked=None) -> None:
+        delta = self.engine.run(unlocked=unlocked)
         self._apply_delta(delta)
 
     def divide_rounds(self) -> None:  # test-surface compatibility
@@ -233,7 +240,8 @@ class TpuHashgraph(Hashgraph):
                 index_base[pid] = r.index + 1
         self.engine = IncrementalEngine(
             n, root_round, capacity=self._capacity, block=self._block,
-            index_base=index_base, from_reset=True)
+            k_capacity=self._k_capacity, index_base=index_base,
+            from_reset=True, mesh=self._mesh, mesh_axis=self._mesh_axis)
         self._eid_of = {}
         self._hex_by_id = []
         self.undecided_rounds = list(self.engine.undecided_rounds)

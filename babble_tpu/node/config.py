@@ -23,6 +23,11 @@ class Config:
     # Consensus engine: "host" (incremental reference-semantics Python)
     # or "tpu" (batched device pipeline behind the same seam).
     engine: str = "host"
+    # Devices for the tpu engine's resident state: 0/1 = single device;
+    # d > 1 builds a d-device jax.sharding.Mesh and the engine's O(E·n)
+    # carries are NamedSharding-partitioned across it (GSPMD inserts
+    # the collectives), so DAG capacity scales with local chips.
+    engine_mesh: int = 0
     # Minimum seconds between consensus passes. 0 = reference behavior
     # (RunConsensus after every sync, node/node.go:467-487). With the
     # device engine each pass costs a device round trip and holds the

@@ -9,6 +9,7 @@ node stats / the HTTP service."""
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -28,6 +29,7 @@ class Core:
         store: Store,
         commit_callback: Optional[Callable[[Block], None]] = None,
         engine: str = "host",
+        engine_mesh: int = 0,
     ):
         self.id = id
         self.key = key
@@ -38,7 +40,43 @@ class Core:
             # JaxStore-sibling integration of SURVEY §7 step 3.
             from ..hashgraph.tpu_graph import TpuHashgraph
 
-            self.hg: Hashgraph = TpuHashgraph(participants, store, commit_callback)
+            mesh = None
+            if engine_mesh and engine_mesh > 1:
+                import jax
+                import numpy as _np
+                from jax.sharding import Mesh
+
+                devs = jax.devices()
+                if len(devs) < engine_mesh:
+                    raise ValueError(
+                        f"engine_mesh={engine_mesh} but only "
+                        f"{len(devs)} devices visible")
+                # The participant columns shard over the mesh, so the
+                # validator count must divide the device count; shrink
+                # to the largest divisor rather than refusing to boot.
+                d = engine_mesh
+                while d > 1 and len(participants) % d:
+                    d -= 1
+                if d != engine_mesh:
+                    logging.getLogger("babble_tpu").warning(
+                        "engine_mesh=%d does not divide %d validators; "
+                        "using %d device(s)", engine_mesh,
+                        len(participants), d)
+                if d > 1:
+                    mesh = Mesh(_np.array(devs[:d]), ("sp",))
+            # Pre-size the engine so steady operation never crosses a
+            # growth threshold: every capacity/chain-bucket doubling is
+            # a NEW static shape, and on a tunneled runtime each
+            # recompile stalls the node (gossip included — the dispatch
+            # holds the core lock) for tens of seconds. A 16k-event /
+            # deep-chain initial footprint costs a few MB at small n;
+            # the chain buckets scale down with n^2 so large-validator
+            # nodes keep the same memory budget.
+            n_p = len(participants)
+            k_cap = max(64, min(4096, (1 << 31) // (4 * n_p * n_p)))
+            self.hg: Hashgraph = TpuHashgraph(
+                participants, store, commit_callback, mesh=mesh,
+                capacity=16384, block=512, k_capacity=k_cap)
         elif engine == "host":
             self.hg = Hashgraph(participants, store, commit_callback)
         else:
@@ -169,9 +207,9 @@ class Core:
     def to_wire(self, events: List[Event]) -> List[WireEvent]:
         return [e.to_wire() for e in events]
 
-    def run_consensus(self) -> None:
+    def run_consensus(self, unlocked=None) -> None:
         t0 = time.perf_counter_ns()
-        self.hg.run_consensus()
+        self.hg.run_consensus(unlocked=unlocked)
         self._timed("run_consensus", t0)
         # Device-engine sub-phases (coords/fd/frontier/fame/rr) when the
         # batched pipeline is active.

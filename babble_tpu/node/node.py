@@ -17,6 +17,7 @@ declares the counters but never updates them — node/node.go:46-47,575)."""
 
 from __future__ import annotations
 
+import contextlib
 import queue
 import threading
 import time
@@ -64,6 +65,7 @@ class Node:
             id, key, pmap, store,
             commit_callback=self.commit_ch.put,
             engine=getattr(conf, "engine", "host"),
+            engine_mesh=getattr(conf, "engine_mesh", 0),
         )
         self.core_lock = threading.Lock()
 
@@ -193,11 +195,26 @@ class Node:
             if self.state.get_state() != old_state:
                 return
 
+    @contextlib.contextmanager
+    def _core_unlocked(self):
+        """Release the core lock around the engine's device-result
+        wait: the dispatched pass reads only its snapshot, so gossip
+        keeps inserting at wire speed while the chip computes instead
+        of queueing behind a 100ms+ device round trip (the cause of
+        stale known-maps and CheckSelfParent sync floods under the
+        tpu engine)."""
+        self.core_lock.release()
+        try:
+            yield
+        finally:
+            self.core_lock.acquire()
+
     def _consensus_loop(self) -> None:
         """Dedicated consensus worker (consensus_interval > 0): a pass
         every interval, off the gossip path, so syncs never block on
         the (device) pipeline — they only contend for the core lock
-        while a pass is applying its results."""
+        while a pass is staging inputs and applying results; the
+        device wait itself runs with the lock released."""
         iv = self.conf.consensus_interval
         while not self._shutdown.is_set():
             self._shutdown.wait(iv)
@@ -205,7 +222,7 @@ class Node:
                 return
             try:
                 with self.core_lock:
-                    self.core.run_consensus()
+                    self.core.run_consensus(unlocked=self._core_unlocked)
             except Exception as exc:  # noqa: BLE001 - keep the loop alive
                 self.logger.error("consensus pass failed: %s", exc)
 

@@ -398,7 +398,8 @@ class IncrementalEngine:
 
     def __init__(self, n: int, root_round=None, *, capacity: int = 256,
                  block: int = 256, k_capacity: int = 64,
-                 index_base=None, from_reset: bool = False):
+                 index_base=None, from_reset: bool = False,
+                 mesh=None, mesh_axis="sp"):
         if n < 1:
             raise ValueError("need at least one participant")
         self.n = n
@@ -437,12 +438,37 @@ class IncrementalEngine:
         self.rr = np.zeros(self.cap, np.int32)  # pad rows 0: never assigned
         self.cts_ns = np.zeros(self.cap, np.int64)
 
+        # Multi-chip option: a jax.sharding.Mesh places the resident
+        # carries with NamedSharding — the O(E·n) coordinate table
+        # partitioned on the participant axis, the chain tables and the
+        # fd rank cube on the chain axis — and GSPMD partitions the
+        # same jitted kernels across the mesh (semantics-preserving;
+        # the compiler inserts the collectives), so a node's DAG
+        # capacity scales with its chips instead of one chip's HBM.
+        # O(E) 1-D int vectors stay replicated, the same tradeoff the
+        # one-shot sharded pipeline makes (ops/sharded.py).
+        self._mesh = mesh
+        self._mesh_axis = mesh_axis
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from .sharded import _axis_size
+
+            daxes = _axis_size(mesh, mesh_axis)
+            if n % daxes:
+                raise ValueError(
+                    f"participants {n} must divide over {daxes} devices")
+            self._shard_cols = NamedSharding(mesh, P(None, mesh_axis))
+            self._shard_ch = NamedSharding(mesh, P(mesh_axis))
+        else:
+            self._shard_cols = self._shard_ch = None
+
         # Device carries. Coordinates plus everything the per-sync
         # pipeline would otherwise re-upload or recompute from scratch:
         # the event arrays (ingested by batch slice), the chain tables
         # (new rows only), and the fd rank cube (incremental
         # compare-and-count; see _tables_update).
-        self._la = jnp.full((c1, n), -1, jnp.int32)
+        self._la = self._put_cols(jnp.full((c1, n), -1, jnp.int32))
         self._rb = jnp.full((c1,), -1, jnp.int32)
         self._frozen_blocks = 0
         self._sp_d = jnp.full((c1,), -1, jnp.int32)
@@ -451,16 +477,18 @@ class IncrementalEngine:
         self._idx_d = jnp.full((c1,), -1, jnp.int32)
         self._coin_d = jnp.zeros((c1,), jnp.int8)
         self._rb0_d = jnp.full((c1,), -1, jnp.int32)
-        self._chain_d = jnp.full((n, self.kcap), -1, jnp.int32)
-        self._ranks = jnp.zeros((n, n, self.kcap), jnp.int32)
+        self._chain_d = self._put_ch(jnp.full((n, self.kcap), -1, jnp.int32))
+        self._ranks = self._put_ch(jnp.zeros((n, n, self.kcap), jnp.int32))
         # chain_la/chain_rb could be re-gathered per run from la/chain
         # (build_chain_tables), but the gather materializes this same
         # [n, K, n] cube transiently anyway (the frontier consumes it),
         # and at n=1024 it would re-read ~2 GB of HBM per sync; keeping
         # it resident costs the same peak memory and only writes the
         # new chain suffix rows.
-        self._chain_la = jnp.full((n, self.kcap, n), INT32_MAX, jnp.int32)
-        self._chain_rb = jnp.full((n, self.kcap), INT32_MAX, jnp.int32)
+        self._chain_la = self._put_ch(
+            jnp.full((n, self.kcap, n), INT32_MAX, jnp.int32))
+        self._chain_rb = self._put_ch(
+            jnp.full((n, self.kcap), INT32_MAX, jnp.int32))
         self._e_counted = 0
         self._len_counted = np.zeros(n, np.int32)
 
@@ -495,6 +523,34 @@ class IncrementalEngine:
         # (node/core.go:278-296). Keys: coords, fd, frontier, rounds,
         # fame_rr.
         self.phase_ns: dict = {}
+
+    # -- mesh placement -----------------------------------------------------
+
+    def _put_cols(self, a):
+        """Place a [cap, n] carry with its participant columns sharded."""
+        if self._shard_cols is None:
+            return a
+        return jax.device_put(a, self._shard_cols)
+
+    def _put_ch(self, a):
+        """Place a chain-axis carry (axis 0 = creator)."""
+        if self._shard_ch is None:
+            return a
+        return jax.device_put(a, self._shard_ch)
+
+    def _constrain_carries(self) -> None:
+        """Re-pin the resident carries to their mesh shardings. The
+        jitted kernels usually propagate input shardings to the donated
+        outputs, but GSPMD is free to choose otherwise; device_put is a
+        no-op when the sharding already matches, so this only ever
+        copies after an actual drift."""
+        if self._mesh is None:
+            return
+        self._la = self._put_cols(self._la)
+        self._chain_d = self._put_ch(self._chain_d)
+        self._ranks = self._put_ch(self._ranks)
+        self._chain_la = self._put_ch(self._chain_la)
+        self._chain_rb = self._put_ch(self._chain_rb)
 
     # -- append ------------------------------------------------------------
 
@@ -667,13 +723,43 @@ class IncrementalEngine:
         self._chain_d = _chain_ingest(
             self._chain_d, self._newtab_d, self._newpos_d, n=n, m=m)
 
-    def run(self) -> RunDelta:
+    def run(self, *, unlocked=None) -> RunDelta:
+        """Run one incremental consensus pass.
+
+        `unlocked` (optional): a context manager factory. When given,
+        the engine releases it ONLY around the blocking device-result
+        wait — a live node passes a core-lock release so gossip keeps
+        inserting at wire speed while the chip computes. This is safe
+        because the pass operates on a SNAPSHOT taken under the lock:
+        the batch ids, e/cap/kcap, and chain lengths are captured
+        before dispatch, every device input is uploaded before the
+        wait, and the post-pull mirror section only touches state that
+        concurrent append() never reads or writes.
+        """
         if self.e == 0 or (self._empty_delta_ok and not self._new_since_run):
             # No-op runs must not leave stale phase timings for callers
             # that aggregate them (node/core.py).
             self.phase_ns = {}
             return RunDelta(last_consensus_round=self.last_consensus_round)
+        new_ids = self._new_since_run
+        self._new_since_run = []
+        try:
+            return self._run_pass(new_ids, unlocked)
+        except BaseException:
+            # Retry safety: a transient device failure (tunnel drop,
+            # preemption) must not orphan the batch's host mirroring —
+            # restore the snapshot (appends that landed during the
+            # unlocked wait follow it) so the next pass redoes it.
+            self._new_since_run = new_ids + self._new_since_run
+            raise
+
+    def _run_pass(self, new_ids, unlocked) -> RunDelta:
         n, sm, e = self.n, self.sm, self.e
+        # Snapshot (see run() docstring): everything below must use
+        # these, not the live fields, once the unlocked wait can
+        # interleave appends.
+        cap0, k0 = self.cap, self.kcap
+        chain_len0 = self.chain_len.copy()
         import os as _os
         import time as _time
 
@@ -697,10 +783,13 @@ class IncrementalEngine:
 
         # 0. Device sync-up: lazy capacity growth, then ingest the new
         # batch into the resident event arrays and chain table. All
-        # dispatches are async — nothing here round-trips.
+        # dispatches are async — nothing here round-trips. Under a mesh,
+        # re-pin the carries first (growth concats and kernel outputs
+        # may drift from the intended shardings).
         self._sync_device()
+        self._constrain_carries()
         self._ingest_batch()
-        chain_len_d = jnp.asarray(self.chain_len)
+        chain_len_d = jnp.asarray(chain_len0)
         cr_d = self._cr_d
         idx_d = self._idx_d
         coin_d = self._coin_d
@@ -712,8 +801,8 @@ class IncrementalEngine:
             self._rb0_d, jnp.int32(self._frozen_blocks), jnp.int32(nb),
             n=n, block=self.block)
         self._frozen_blocks = e // self.block
-        la = self._la[: self.cap]
-        rb = self._rb[: self.cap]
+        la = self._la[:cap0]
+        rb = self._rb[:cap0]
         _mark("coords", la)
 
         # 2. First descendants from the resident rank cube, folding the
@@ -725,7 +814,7 @@ class IncrementalEngine:
                 self._la, self._rb, self._newtab_d, self._newpos_d,
                 n=n, m=self._new_m)
             self._e_counted = e
-            self._len_counted = self.chain_len.copy()
+            self._len_counted = chain_len0.copy()
         fd = _fd_from_ranks(self._ranks, chain_len_d, cr_d, idx_d, n=n)
         _mark("fd", fd)
 
@@ -752,13 +841,13 @@ class IncrementalEngine:
         # Batch range for device-side round assignment (contiguous ids;
         # same floor-64 bucketing as _ingest_batch so live-node syncs
         # share one compile).
-        e0_b = self._new_since_run[0] if self._new_since_run else e
+        e0_b = new_ids[0] if new_ids else e
         b_new = e - e0_b
         bp = _pow2(max(b_new, 1), 64)
         # Bound by cap (not cap+1): the kernel's rounds/rr vectors are
         # cap long, and a clamped dynamic_update_slice would silently
         # shift every batch round one slot down.
-        while e0_b + bp > self.cap and bp > b_new:
+        while e0_b + bp > cap0 and bp > b_new:
             bp //= 2
         if bp < max(b_new, 1):
             bp = max(b_new, 1)
@@ -766,15 +855,15 @@ class IncrementalEngine:
         # Timestamp ranks are global-sort positions, recomputed per
         # call because new timestamps interleave with old ones.
         ts_values, inv = np.unique(self.ts_ns[:e], return_inverse=True)
-        chain_rank = np.full((n, self.kcap), -1, np.int32)
+        chain_rank = np.full((n, k0), -1, np.int32)
         valid = self.chain >= 0
         safe = np.where(valid, self.chain, 0)
         ranks = inv.astype(np.int32)
         chain_rank[valid] = ranks[safe[valid]]
 
         undecided_set = set(self.undecided_rounds)
-        rounds_up = jnp.asarray(self.rounds[: self.cap])
-        rr_up = jnp.asarray(self.rr[: self.cap])
+        rounds_up = jnp.asarray(self.rounds[:cap0])
+        rr_up = jnp.asarray(self.rr[:cap0])
         rank_up = jnp.asarray(chain_rank)
 
         # Fame/rr window widths: the spans actually needed, not the
@@ -804,12 +893,17 @@ class IncrementalEngine:
         # batch worth of events; a late fame decision can release a
         # backlog, detected post-pull (newly_count) and redone bigger.
         # _last_newly keeps the bucket sticky across bursty stretches.
-        cb = min(_pow2(max(2 * b_new, self._last_newly, 64)), self.cap)
+        cb = min(_pow2(max(2 * b_new, self._last_newly, 64)), cap0)
 
-        rcap = _pow2(rel_rows + 8, 16)
+        # Floor 64: each distinct rcap is a static shape of the fused
+        # kernel, and on the tunneled runtime a recompile stalls a sync
+        # for seconds — a long-running node would otherwise recompile at
+        # every 16->32->64 table growth. The extra packed-pull bytes
+        # (2*rcap*n int32) are sub-millisecond even at n=1024.
+        rcap = _pow2(rel_rows + 8, 64)
         while True:
             wt_tab = np.full((rcap, n), -1, np.int32)
-            fr_tab = np.full((rcap, n), self.kcap, np.int32)
+            fr_tab = np.full((rcap, n), k0, np.int32)
             wt_tab[:t0] = self._wt_table[:t0]
             fr_tab[:t0] = self._fr_table[:t0]
             # rho_min-relative round bookkeeping from the PREVIOUS run:
@@ -825,7 +919,7 @@ class IncrementalEngine:
                 fam_rel[t] = self.famous[rho]
                 in_list_rel[t] = rho in undecided_set
             rx0 = rx0_known
-            packed = np.asarray(_consensus_fused(
+            packed_dev = _consensus_fused(
                 self._chain_la, self._chain_rb, chain_len_d, la, fd, rb,
                 self._chain_d, jnp.asarray(wt_tab), jnp.asarray(fr_tab),
                 wt_prev, fr_prev, jnp.int32(t0), jnp.int32(self.rho_min),
@@ -834,7 +928,17 @@ class IncrementalEngine:
                 jnp.asarray(fam_rel), jnp.asarray(in_list_rel),
                 rank_up, jnp.int32(rx0),
                 jnp.int32(self._prev_first_undec),
-                n=n, sm=sm, rcap=rcap, bp=bp, rw=rw, iw=iw, cb=cb))
+                n=n, sm=sm, rcap=rcap, bp=bp, rw=rw, iw=iw, cb=cb)
+            # The one blocking device->host wait of the pass. With an
+            # `unlocked` seam, the caller's lock is released here —
+            # every input above was uploaded already, and everything
+            # below uses the run's snapshot, so interleaved appends
+            # are safe (see docstring).
+            if unlocked is not None:
+                with unlocked():
+                    packed = np.asarray(packed_dev)
+            else:
+                packed = np.asarray(packed_dev)
             t_end = int(packed[0])
             newly_count = int(packed[1])
             if t_end == rcap:
@@ -863,7 +967,7 @@ class IncrementalEngine:
                     or newly_count > cb):
                 rw = _pow2(max(r_hi - rx0, 1))
                 iw = _pow2(max(r_hi - i0_true, 1))
-                cb = min(_pow2(max(newly_count, 64)), self.cap)
+                cb = min(_pow2(max(newly_count, 64)), cap0)
                 continue
             break
 
@@ -878,16 +982,16 @@ class IncrementalEngine:
         off += bp
         famous_merged = packed[off:off + rw * n].reshape(rw, n)
         off += rw * n
-        rr_np = packed[off:off + self.cap]
-        off += self.cap
+        rr_np = packed[off:off + cap0]
+        off += cap0
         cts_np = packed[off:]
         _mark("consensus")
 
-        active = (fr_all < self.chain_len[None, :]).any(axis=1)
+        active = (fr_all < chain_len0[None, :]).any(axis=1)
         n_rows = int(np.nonzero(active)[0][-1]) + 1 if active.any() else 0
         self._fr_table = fr_all[:n_rows]
         self._wt_table = wt_all[:n_rows]
-        self._chain_len_prev = self.chain_len.copy()
+        self._chain_len_prev = chain_len0.copy()
         self._last_growth = max(n_rows - rel_rows, 1)
         self._last_newly = max(newly_count, 64)
         r_total = self.rho_min + n_rows
@@ -903,7 +1007,7 @@ class IncrementalEngine:
 
         # Host mirrors of the device-computed rounds (reference
         # DivideRounds bookkeeping, hashgraph.go:616-646).
-        for j, i in enumerate(self._new_since_run):
+        for j, i in enumerate(new_ids):
             rnd = int(rnd_b[j])
             wit = bool(wit_b[j])
             self.rounds[i] = rnd
@@ -940,7 +1044,7 @@ class IncrementalEngine:
                     delta.last_commited_round_events = int(
                         (self.rounds[:e] == rho - 1).sum())
 
-        newly = (rr_np >= 0) & (self.rr[: self.cap] < 0)
+        newly = (rr_np >= 0) & (self.rr[:cap0] < 0)
         newly[e:] = False
         for i in np.nonzero(newly)[0]:
             rr_i = int(rr_np[i])
@@ -957,8 +1061,9 @@ class IncrementalEngine:
         self._prev_first_undec = (
             self.undecided_rounds[0] if self.undecided_rounds else r_total)
 
-        self._new_since_run = []
-        self._empty_delta_ok = True
+        # An append that slipped in during the unlocked wait means the
+        # state is NOT at a fixpoint yet.
+        self._empty_delta_ok = not self._new_since_run
         return delta
 
     # -- queries -----------------------------------------------------------

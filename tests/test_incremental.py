@@ -184,6 +184,104 @@ def test_tpu_graph_get_frame_matches_host():
             hr.x, hr.y, hr.index, hr.round, hr.others), pk
 
 
+def test_run_unlocked_appends_interleave():
+    """The live node releases the core lock around the device-result
+    wait (node/node.py _core_unlocked), so appends can land MID-run.
+    The pass must operate on its snapshot — neither corrupting results
+    for the dispatched batch nor losing the interleaved events. Final
+    state must equal a serial engine fed the same stream."""
+    import contextlib
+
+    from babble_tpu.ops.dag import synthetic_dag as sdag
+
+    n, e, bs = 8, 400, 57
+    dag, _ = sdag(n, e, seed=9)
+    batches = [(k, min(k + bs, e)) for k in range(0, e, bs)]
+
+    def feed(g, k, hi):
+        g.append_batch(
+            dag.self_parent[k:hi], dag.other_parent[k:hi],
+            dag.creator[k:hi], dag.index[k:hi], dag.coin[k:hi],
+            np.arange(k, hi))
+
+    ref = IncrementalEngine(n, capacity=64, block=64, k_capacity=8)
+    for k, hi in batches:
+        feed(ref, k, hi)
+        ref.run()
+
+    eng = IncrementalEngine(n, capacity=64, block=64, k_capacity=8)
+    state = {"next": 1}
+
+    @contextlib.contextmanager
+    def interleave():
+        # Fires exactly where the node's lock release does: during the
+        # blocking pull. Inject the next batch right there.
+        if state["next"] < len(batches):
+            k, hi = batches[state["next"]]
+            state["next"] += 1
+            feed(eng, k, hi)
+        yield
+
+    feed(eng, *batches[0])
+    for _ in range(3 * len(batches)):
+        eng.run(unlocked=interleave)
+        if state["next"] >= len(batches):
+            break
+    eng.run()  # drain whatever the last interleave injected
+
+    assert (eng.rounds[:e] == ref.rounds[:e]).all()
+    assert (eng.witness[:e] == ref.witness[:e]).all()
+    assert (eng.rr[:e] == ref.rr[:e]).all()
+    assert (eng.cts_ns[:e] == ref.cts_ns[:e]).all()
+    assert (eng.famous == ref.famous).all()
+    assert eng.undecided_rounds == ref.undecided_rounds
+
+
+def test_run_retries_after_transient_failure():
+    """A pass that dies mid-flight (tunnel drop, preemption) must not
+    orphan its batch: the snapshot is restored, the node's consensus
+    worker retries, and the retry produces the same results as a
+    never-failed engine."""
+    import contextlib
+
+    from babble_tpu.ops.dag import synthetic_dag as sdag
+
+    n, e = 8, 200
+    dag, _ = sdag(n, e, seed=4)
+
+    def feed(g, k, hi):
+        g.append_batch(
+            dag.self_parent[k:hi], dag.other_parent[k:hi],
+            dag.creator[k:hi], dag.index[k:hi], dag.coin[k:hi],
+            np.arange(k, hi))
+
+    ref = IncrementalEngine(n, capacity=64, block=64, k_capacity=8)
+    feed(ref, 0, 120)
+    ref.run()
+    feed(ref, 120, e)
+    ref.run()
+
+    eng = IncrementalEngine(n, capacity=64, block=64, k_capacity=8)
+    feed(eng, 0, 120)
+
+    @contextlib.contextmanager
+    def tunnel_drop():
+        raise RuntimeError("tunnel dropped")
+        yield  # pragma: no cover
+
+    with pytest.raises(RuntimeError):
+        eng.run(unlocked=tunnel_drop)
+    eng.run()  # retry re-mirrors the restored batch
+    feed(eng, 120, e)
+    eng.run()
+
+    assert (eng.rounds[:e] == ref.rounds[:e]).all()
+    assert (eng.witness[:e] == ref.witness[:e]).all()
+    assert (eng.rr[:e] == ref.rr[:e]).all()
+    assert (eng.famous == ref.famous).all()
+    assert eng.undecided_rounds == ref.undecided_rounds
+
+
 # ---------------------------------------------------------------- reset
 
 

@@ -123,7 +123,7 @@ def test_core_over_sync_limit():
 # ---------------------------------------------------------------- nodes
 
 
-def make_nodes(n, transport, engine="host"):
+def make_nodes(n, transport, engine="host", engine_mesh=0):
     if transport == "tcp":
         transports = [
             TCPTransport("127.0.0.1:0", timeout=2.0) for _ in range(n)
@@ -144,6 +144,14 @@ def make_nodes(n, transport, engine="host"):
     for i, (key, peer) in enumerate(entries):
         conf = fast_config(heartbeat=0.01 if transport == "inmem" else 0.05)
         conf.engine = engine
+        conf.engine_mesh = engine_mesh
+        if engine == "tpu":
+            # Production cadence (cli.py default): a dedicated
+            # consensus worker batching syncs per device pass, with
+            # the core lock released around the device wait — the
+            # unlocked seam must be exercised by gossip, not only by
+            # the deterministic interleave unit test.
+            conf.consensus_interval = 0.05
         store = InmemStore(participants, CACHE)
         proxy = InmemAppProxy()
         node = Node(conf, i, key, peers, store, by_addr[peer.net_addr], proxy)
