@@ -143,10 +143,10 @@ def _chain_ingest(chain_d, newtab, newpos, *, n, m):
     return chain_d.at[crows, pos].set(newtab, mode="drop")
 
 
-# Working-set bound for the incremental fd-rank update's
-# [n, m, n, tc] compare cube (sized to trade kernel count for VMEM
-# pressure: on the tunneled runtime sequential tiny kernels, not FLOPs,
-# bound the sync).
+# Working-set bound for the incremental fd-rank update's histogram +
+# cumsum transients (sized to trade kernel count for VMEM pressure: on
+# the tunneled runtime sequential tiny kernels, not FLOPs, bound the
+# sync).
 _FD_CHUNK_ELEMS = 1 << 26
 
 
@@ -162,8 +162,10 @@ def _tables_update(ranks, chain_la, chain_rb, la, rb, newtab, newpos,
         incremental form of kernels.first_descendant_cube's
         compare-and-count: old events' la rows never change, so the
         count over a chain only grows by the new suffix contributions
-        (reference semantics hashgraph.go:490-530). Per-sync cost is
-        O(batch * n * K) instead of the full cube's O(n^2 * K^2).
+        (reference semantics hashgraph.go:490-530). The count is a
+        histogram over la values + a cumulative sum along the
+        threshold axis — O(n^2·K + batch·n) work, replacing the dense
+        [batch, K] compare cube's O(batch·n^2·K).
     """
     k = ranks.shape[2]
     cap1 = la.shape[0]
@@ -178,19 +180,29 @@ def _tables_update(ranks, chain_la, chain_rb, la, rb, newtab, newpos,
     chain_rb = chain_rb.at[crows, pos].set(
         jnp.where(valid, rb_new, INT32_MAX), mode="drop")
 
-    tc = max(min(_FD_CHUNK_ELEMS // max(n * m * n, 1), k), 1)
-    while k % tc:
-        tc -= 1
-    nchunks = k // tc
+    # An event with la value v counts for every threshold t > v, i.e.
+    # t >= v + 1: bucket v+1 in a per-(chain, creator) histogram, then
+    # cnt[t] = cumsum(hist)[t]. Invalid lanes bucket to k — beyond the
+    # ranks slice — and drop out. Chunked over the creator axis so the
+    # hist + cumsum transients stay under the working-set bound (the
+    # resident ranks cube is already n^2·K; the transients must not
+    # triple that at large n·K).
+    v = jnp.where(valid[:, :, None], jnp.clip(la_new + 1, 0, k), k)
+    ic = max(min(_FD_CHUNK_ELEMS // max(n * (k + 1), 1), n), 1)
+    while n % ic:
+        ic -= 1
+    nchunks = n // ic
+    c_ix = jnp.broadcast_to(jnp.arange(n)[:, None, None], (n, m, ic))
+    i_ix = jnp.broadcast_to(jnp.arange(ic)[None, None, :], (n, m, ic))
 
     def chunk(g, ranks):
-        t0 = g * tc
-        ts = t0 + jnp.arange(tc, dtype=jnp.int32)
-        cmp = valid[:, :, None, None] & (
-            la_new[:, :, :, None] < ts[None, None, None, :])
-        delta = cmp.sum(1, dtype=jnp.int32)  # [n, n, tc]
-        blk = lax.dynamic_slice(ranks, (0, 0, t0), (n, n, tc)) + delta
-        return lax.dynamic_update_slice(ranks, blk, (0, 0, t0))
+        i0c = g * ic
+        v_g = lax.dynamic_slice(v, (0, 0, i0c), (n, m, ic))
+        hist = jnp.zeros((n, ic, k + 1), jnp.int32).at[
+            c_ix, i_ix, v_g].add(1)
+        delta = jnp.cumsum(hist, axis=2)[:, :, :k]
+        blk = lax.dynamic_slice(ranks, (0, i0c, 0), (n, ic, k)) + delta
+        return lax.dynamic_update_slice(ranks, blk, (0, i0c, 0))
 
     ranks = lax.fori_loop(0, nchunks, chunk, ranks)
     return ranks, chain_la, chain_rb
@@ -218,7 +230,7 @@ def _consensus_fused(chain_la, chain_rb_tab, chain_len, la, fd, rb_vec,
                      chain, wt_tab, fr_tab, wt_prev, fr_prev, t0, rho_min,
                      self_parent, creator, index, coin, e0, e1,
                      rounds_host, rr_prev, fam_rel, in_list_rel,
-                     chain_rank, rx0, first_undec_prev,
+                     chain_rank, rx0, first_undec_prev, und_ids, n_und,
                      *, n, sm, rcap, bp, rw, iw, cb):
     """The whole per-sync consensus tail in one dispatch — frontier
     sweep, new-event rounds, fame merge, round-received — returning a
@@ -320,15 +332,31 @@ def _consensus_fused(chain_la, chain_rb_tab, chain_len, la, fd, rb_vec,
     creator_e = creator[:e]
     index_e = index[:e]
 
-    def step(t, rr):
+    # The sweep runs over the UNDECIDED window only (host-gathered ids
+    # with rr < 0): decided events never change, so each of the iw
+    # sequential steps compares [n, |undecided|] instead of [n, E] —
+    # the dominant per-sync cost once the DAG is deep.
+    au = und_ids.shape[0]
+    lane_ok = jnp.arange(au) < n_und
+    uid = jnp.where(lane_ok, und_ids, 0)
+    cr_u = creator[uid]
+    ix_u = index[uid]
+    rnd_u = rounds_all[uid]
+    rr_u0 = jnp.where(lane_ok, rr_prev[uid], 0)  # pad lanes: never assigned
+
+    def step(t, rr_u):
         i = i0 + t
         la_w = la[wt_safe[t]]  # [n(w), n]
-        see_wx = la_w[:, creator_e] >= index_e[None, :]  # [n(w), E]
+        see_wx = la_w[:, cr_u] >= ix_u[None, :]  # [n(w), au]
         s_cnt = (see_wx & fmask[t][:, None]).sum(0)
-        ok = elig[t] & (s_cnt > fcnt[t] // 2) & (i > rounds_all[:e]) & (rr < 0)
-        return jnp.where(ok, i, rr)
+        ok = (elig[t] & (s_cnt > fcnt[t] // 2) & (i > rnd_u)
+              & (rr_u < 0) & lane_ok)
+        return jnp.where(ok, i, rr_u)
 
-    rr = lax.fori_loop(0, iw, step, rr_prev)
+    rr_u = lax.fori_loop(0, iw, step, rr_u0)
+    rr = rr_prev.at[
+        jnp.where(lane_ok, uid, rr_prev.shape[0])
+    ].set(rr_u, mode="drop")
     newly = (rr >= 0) & (rr_prev < 0)
     newly_count = newly.sum(dtype=jnp.int32)
 
@@ -866,6 +894,16 @@ class IncrementalEngine:
         rr_up = jnp.asarray(self.rr[:cap0])
         rank_up = jnp.asarray(chain_rank)
 
+        # Undecided-event window for the round-received sweep: decided
+        # events never change, so the kernel's per-round pass compares
+        # against this compacted id set instead of all E events.
+        und = np.nonzero(self.rr[:e] < 0)[0].astype(np.int32)
+        au = _pow2(len(und), 1024)
+        und_p = np.zeros(au, np.int32)
+        und_p[: len(und)] = und
+        und_up = jnp.asarray(und_p)
+        n_und = jnp.int32(len(und))
+
         # Fame/rr window widths: the spans actually needed, not the
         # table capacity — decide_fame costs O(rw^2) sequential steps
         # and the rr sweep O(iw) sequential [n, E] passes, and on this
@@ -927,7 +965,7 @@ class IncrementalEngine:
                 jnp.int32(e0_b), jnp.int32(e), rounds_up, rr_up,
                 jnp.asarray(fam_rel), jnp.asarray(in_list_rel),
                 rank_up, jnp.int32(rx0),
-                jnp.int32(self._prev_first_undec),
+                jnp.int32(self._prev_first_undec), und_up, n_und,
                 n=n, sm=sm, rcap=rcap, bp=bp, rw=rw, iw=iw, cb=cb)
             # The one blocking device->host wait of the pass. With an
             # `unlocked` seam, the caller's lock is released here —

@@ -591,6 +591,18 @@ def child():
                 _emit(payload)
             except Exception as exc:  # noqa: BLE001
                 log(f"  node tpu stage failed: {exc}")
+        # 16 validators on one machine — 4x the reference's published
+        # deployment size, host engine (16 independent engines).
+        if _budget_left() > 150:
+            try:
+                node_eps = node_testnet_events_per_sec(
+                    engine="host", n_nodes=16, warm_s=45.0, window_s=30.0)
+                log(f"  16-node --engine host testnet: {node_eps:,.1f} "
+                    f"committed events/s")
+                payload["node16_events_per_s"] = round(node_eps, 1)
+                _emit(payload)
+            except Exception as exc:  # noqa: BLE001
+                log(f"  node 16 stage failed: {exc}")
 
     # -- stage 3: north star n=1024 e=100k --------------------------------
     # Skipped on the CPU fallback: at this size a host CPU cannot finish
