@@ -14,6 +14,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from .. import crypto
+from ..common import StoreError
 from ..hashgraph.block import Block
 from ..hashgraph.event import Event, WireEvent
 from ..hashgraph.graph import Hashgraph
@@ -68,15 +69,19 @@ class Core:
             # growth threshold: every capacity/chain-bucket doubling is
             # a NEW static shape, and on a tunneled runtime each
             # recompile stalls the node (gossip included — the dispatch
-            # holds the core lock) for tens of seconds. A 16k-event /
-            # deep-chain initial footprint costs a few MB at small n;
-            # the chain buckets scale down with n^2 so large-validator
-            # nodes keep the same memory budget.
+            # holds the core lock) for tens of seconds; with several
+            # nodes sharing a chip the compiles serialize into a
+            # minutes-long network freeze (observed when the 16k event
+            # and 4k chain boundaries landed together). 64k events and
+            # a ~256MB chain-table budget push both boundaries past any
+            # realistic session at small n; chain buckets scale down
+            # with n^2 so large-validator nodes keep the same budget.
             n_p = len(participants)
-            k_cap = max(64, min(4096, (1 << 31) // (4 * n_p * n_p)))
+            cap = 65536
+            k_cap = max(64, min(cap, (1 << 28) // (4 * n_p * n_p)))
             self.hg: Hashgraph = TpuHashgraph(
                 participants, store, commit_callback, mesh=mesh,
-                capacity=16384, block=512, k_capacity=k_cap)
+                capacity=cap, block=512, k_capacity=k_cap)
         elif engine == "host":
             self.hg = Hashgraph(participants, store, commit_callback)
         else:
@@ -167,12 +172,28 @@ class Core:
 
     def sync(self, unknown: List[WireEvent]) -> None:
         """Insert synced events, then wrap the tx pool and the other
-        party's head in a new self-event — reference node/core.go:190-230."""
+        party's head in a new self-event — reference node/core.go:190-230.
+
+        Events already in the store are SKIPPED rather than failing the
+        batch: this node answers pulls and accepts pushes concurrently
+        (the core lock is released during the pull round trip), so a
+        response computed against a slightly stale known-map routinely
+        overlaps a concurrent push. Events are content-addressed, so a
+        duplicate is byte-identical and skipping is consensus-neutral —
+        whereas aborting the whole batch (the reference's behavior
+        under its fully-serialized gossip) wedges a node permanently
+        once every peer's syncs overlap."""
         t0 = time.perf_counter_ns()
         other_head = ""
         for k, we in enumerate(unknown):
             ev = self.hg.read_wire_info(we)
-            self.insert_event(ev, False)
+            try:
+                self.hg.store.get_event(ev.hex())
+                known = True
+            except StoreError:
+                known = False
+            if not known:
+                self.insert_event(ev, False)
             if k == len(unknown) - 1:
                 other_head = ev.hex()
 

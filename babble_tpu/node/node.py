@@ -68,6 +68,8 @@ class Node:
             engine_mesh=getattr(conf, "engine_mesh", 0),
         )
         self.core_lock = threading.Lock()
+        # At most two gossip rounds in flight (see _babble).
+        self._gossip_slots = threading.Semaphore(2)
 
         self.peer_selector = RandomPeerSelector(participants, self.local_addr)
         self.selector_lock = threading.Lock()
@@ -180,11 +182,23 @@ class Node:
 
             if ticked:
                 if gossip:
-                    proceed = self._pre_gossip()
-                    peer = self.peer_selector.next() if proceed else None
-                    if peer is not None:
-                        addr = peer.net_addr
-                        self.state.go_func(lambda: self._gossip(addr))
+                    # Bounded concurrency: without the semaphore every
+                    # heartbeat tick spawns a gossip round, and once
+                    # syncs slow down (peer busy, device wait) rounds
+                    # pile up into a 100-thread convoy that freezes the
+                    # whole process. Two in flight keeps pull/push
+                    # overlap without the pile-up (the reference's
+                    # gossip rounds are effectively sequential).
+                    if self._gossip_slots.acquire(blocking=False):
+                        proceed = self._pre_gossip()
+                        peer = (self.peer_selector.next()
+                                if proceed else None)
+                        if peer is not None:
+                            addr = peer.net_addr
+                            self.state.go_func(
+                                lambda: self._gossip_bounded(addr))
+                        else:
+                            self._gossip_slots.release()
                 if not self.core.need_gossip():
                     self.control_timer.stop()
                 elif not self.control_timer.set:
@@ -194,6 +208,12 @@ class Node:
                 return
             if self.state.get_state() != old_state:
                 return
+
+    def _gossip_bounded(self, addr: str) -> None:
+        try:
+            self._gossip(addr)
+        finally:
+            self._gossip_slots.release()
 
     @contextlib.contextmanager
     def _core_unlocked(self):

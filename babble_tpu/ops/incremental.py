@@ -853,8 +853,20 @@ class IncrementalEngine:
         # pulls are now derived on device from host bookkeeping tables.
         rel_rows = len(self._fr_table)
         if rel_rows:
+            # A row can only change when a chain it is still waiting on
+            # GROWS: frozen-row stability (module docstring) means old
+            # positions never newly strongly-see, so row t is affected
+            # only by chains c with fr[t, c] at/beyond the last-seen
+            # end AND new events this sync. Without the `grew` mask a
+            # single lagging peer marks every row past its head
+            # permanently growable, and each pass re-sweeps hundreds of
+            # rounds — a death spiral in a live testnet (slow passes ->
+            # more lag -> longer sweeps). With it, the catch-up cost is
+            # paid once, in the sync where the laggard's events arrive.
+            grew = chain_len0 > self._chain_len_prev
             growable = (
-                self._fr_table >= self._chain_len_prev[None, :]
+                (self._fr_table >= self._chain_len_prev[None, :])
+                & grew[None, :]
             ).any(axis=1)
             t0 = int(np.argmax(growable)) if growable.any() else rel_rows
         else:

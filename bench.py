@@ -315,6 +315,18 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
     (reference docs/usage.rst:31-34)."""
     import threading
 
+    import jax as _jax
+
+    # The persistent compile cache is the product default (cli.py
+    # enables it for every tpu-engine node); without it the warmup
+    # re-pays every engine-shape compile and the window lands in the
+    # immature phase. child() also sets this, but the function must be
+    # self-sufficient for standalone calls (verification drives import
+    # bench and call it directly).
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    _jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
     from babble_tpu import crypto
     from babble_tpu.hashgraph import InmemStore
     from babble_tpu.net import InmemTransport, Peer
@@ -367,8 +379,14 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
             nd.run_async(gossip=True)
         bomber = threading.Thread(target=bombard, daemon=True)
         bomber.start()
+        # Warmup gate: the tunneled runtime compiles each engine shape
+        # per process (~2 min for the live-node presize at small n; the
+        # persistent cache does not cover this backend), and the first
+        # post-compile minutes still hit occasional window-growth
+        # compiles — so the gate requires enough committed events to
+        # prove MATURE steady state, under a generous cap.
         deadline = time.monotonic() + warm_s
-        while time.monotonic() < deadline and committed() < 50:
+        while time.monotonic() < deadline and committed() < 1500:
             time.sleep(0.5)
         c0, t0 = committed(), time.monotonic()
         time.sleep(window_s)
@@ -581,10 +599,10 @@ def child():
                 _emit(payload)
             except Exception as exc:  # noqa: BLE001
                 log(f"  node host stage failed: {exc}")
-        if _budget_left() > 300 and not on_cpu:
+        if _budget_left() > 450 and not on_cpu:
             try:
                 node_eps = node_testnet_events_per_sec(
-                    engine="tpu", warm_s=120.0, window_s=30.0)
+                    engine="tpu", warm_s=330.0, window_s=75.0)
                 log(f"  4-node --engine tpu testnet (one shared chip): "
                     f"{node_eps:,.1f} committed events/s")
                 payload["node_tpu_events_per_s"] = round(node_eps, 1)
