@@ -6,6 +6,9 @@ set -euo pipefail
 cd "$(dirname "$0")/../.."
 NODES="${NODES:-4}" BASE_PORT="${BASE_PORT:-22000}"
 HEARTBEAT="${HEARTBEAT:-50}" ENGINE="${ENGINE:-host}" CONF="demo/conf"
+# d > 1: shard engine state over d devices (requires ENGINE=tpu;
+# ignored by the host engine)
+ENGINE_MESH="${ENGINE_MESH:-0}"
 [ -d "$CONF/node0" ] || { echo "run conf.sh first" >&2; exit 1; }
 : > "$CONF/pids"
 for i in $(seq 0 $((NODES - 1))); do
@@ -16,7 +19,8 @@ for i in $(seq 0 $((NODES - 1))); do
     --proxy_addr "127.0.0.1:$((p + 1))" \
     --client_addr "127.0.0.1:$((p + 2))" \
     --service_addr "127.0.0.1:$((BASE_PORT + 1000 + i))" \
-    --heartbeat "$HEARTBEAT" --engine "$ENGINE" --log_level info \
+    --heartbeat "$HEARTBEAT" --engine "$ENGINE" \
+    --engine_mesh "$ENGINE_MESH" --log_level info \
     >"$CONF/logs/node$i.log" 2>&1 &
   echo $! >> "$CONF/pids"
   python -m babble_tpu.dummy --name "client$i" \
