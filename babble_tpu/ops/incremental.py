@@ -225,13 +225,15 @@ def _fd_from_ranks(ranks, chain_len, creator, index, *, n):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n", "sm", "rcap", "bp", "rw", "iw", "cb"))
+    jax.jit,
+    static_argnames=("n", "sm", "rcap", "bp", "rw", "iw", "cb", "tw"))
 def _consensus_fused(chain_la, chain_rb_tab, chain_len, la, fd, rb_vec,
                      chain, wt_tab, fr_tab, wt_prev, fr_prev, t0, rho_min,
                      self_parent, creator, index, coin, e0, e1,
                      rounds_host, rr_prev, fam_rel, in_list_rel,
                      chain_rank, rx0, first_undec_prev, und_ids, n_und,
-                     *, n, sm, rcap, bp, rw, iw, cb):
+                     t_start,
+                     *, n, sm, rcap, bp, rw, iw, cb, tw):
     """The whole per-sync consensus tail in one dispatch — frontier
     sweep, new-event rounds, fame merge, round-received — returning a
     single packed int32 buffer so the host pays exactly ONE
@@ -250,8 +252,13 @@ def _consensus_fused(chain_la, chain_rb_tab, chain_len, la, fd, rb_vec,
     window take device-merged values exactly as the reference's
     DecideFame/DecideRoundReceived interleave (hashgraph.go:649-799).
 
-    Packed layout: [t_end, wt_tab(rcap*n), fr_tab(rcap*n),
-    new_rounds(bp), new_wit(bp), famous_merged(rcap*n), rr(E), cts(E)].
+    Packed layout (the tunneled runtime charges ~119ms per pull PLUS
+    ~100ms/MB, so every plane is window-sized, never E- or cap-sized):
+    [t_end, newly_count, wt_win(tw*n), fr_win(tw*n), new_rounds(bp),
+    new_wit(bp), famous_merged(rw*n), rr_u(au), cts_u(au)] where
+    wt/fr_win are the swept table rows [t_start, t_start+tw) (the only
+    rows that can have changed) and rr_u/cts_u are per-lane results for
+    the host's undecided-event window.
     """
     e = rounds_host.shape[0]
     k = chain_rank.shape[1]
@@ -329,8 +336,6 @@ def _consensus_fused(chain_la, chain_rb_tab, chain_len, la, fd, rb_vec,
     fmask = (fam_rr == FAME_TRUE) & wt_valid
     fcnt = fmask.sum(1)
     idx_w = jnp.where(wt_valid, index[wt_safe], -1)
-    creator_e = creator[:e]
-    index_e = index[:e]
 
     # The sweep runs over the UNDECIDED window only (host-gathered ids
     # with rr < 0): decided events never change, so each of the iw
@@ -354,30 +359,27 @@ def _consensus_fused(chain_la, chain_rb_tab, chain_len, la, fd, rb_vec,
         return jnp.where(ok, i, rr_u)
 
     rr_u = lax.fori_loop(0, iw, step, rr_u0)
-    rr = rr_prev.at[
-        jnp.where(lane_ok, uid, rr_prev.shape[0])
-    ].set(rr_u, mode="drop")
-    newly = (rr >= 0) & (rr_prev < 0)
-    newly_count = newly.sum(dtype=jnp.int32)
+    newly_l = (rr_u >= 0) & (rr_u0 < 0) & lane_ok
+    newly_count = newly_l.sum(dtype=jnp.int32)
 
-    # Consensus timestamps only for the rows that were JUST assigned —
+    # Consensus timestamps only for the lanes that were JUST assigned —
     # compacted to a static [cb] bucket so the median machinery (the
     # [rows, n] gathers and the per-row sort) scales with the sync's
-    # decisions, not with E. argsort(~newly) is stable, so the first
-    # newly_count lanes are exactly the newly-received event ids; if
-    # the bucket overflows (a late fame decision releasing a huge
-    # backlog), newly_count > cb tells the host to redo with a bigger
-    # bucket.
-    order = jnp.argsort(~newly)
-    sel = order[:cb]  # [cb] event ids, newly rows first
-    live = newly[sel]
-    t_sel = jnp.clip(rr[sel] - i0, 0, iw - 1)
+    # decisions, not with E. argsort(~newly_l) is stable, so the first
+    # newly_count lanes are exactly the newly-received lanes; if the
+    # bucket overflows (a late fame decision releasing a huge backlog),
+    # newly_count > cb tells the host to redo with a bigger bucket.
+    order = jnp.argsort(~newly_l)
+    sel_l = order[:cb]  # [cb] lanes, newly lanes first
+    live = newly_l[sel_l]
+    sel_ids = uid[sel_l]
+    t_sel = jnp.clip(rr_u[sel_l] - i0, 0, iw - 1)
     w_sel = wt_safe[t_sel]  # [cb, n]
     fm_sel = fmask[t_sel]
     idxw_sel = idx_w[t_sel]
-    cr_sel = creator_e[sel]
-    ix_sel = index_e[sel]
-    fd_sel = fd[sel]  # [cb, n]
+    cr_sel = creator[sel_ids]
+    ix_sel = index[sel_ids]
+    fd_sel = fd[sel_ids]  # [cb, n]
     see_sel = la[w_sel, cr_sel[:, None]] >= ix_sel[:, None]
     s_mask = see_sel & fm_sel
     s_cnt = s_mask.sum(1)
@@ -387,16 +389,21 @@ def _consensus_fused(chain_la, chain_rb_tab, chain_len, la, fd, rb_vec,
     tvals = jnp.where(s_mask, tsv, INT32_MAX)
     sorted_t = jnp.sort(tvals, axis=1)
     med = jnp.take_along_axis(sorted_t, (s_cnt // 2)[:, None], axis=1)[:, 0]
-    # Scatter back to [E]; non-newly lanes (and rows beyond the live
-    # prefix) keep the sentinel.
-    cts = jnp.full((e,), ZERO_TS_RANK, jnp.int32)
-    cts = cts.at[jnp.where(live, sel, e)].set(
+    # Scatter back to lanes; non-newly lanes keep the sentinel.
+    cts_u = jnp.full((au,), ZERO_TS_RANK, jnp.int32)
+    cts_u = cts_u.at[jnp.where(live, sel_l, au)].set(
         jnp.where(live, med, ZERO_TS_RANK), mode="drop")
+
+    # Only rows [t_start, t_start + tw) of the frontier tables can have
+    # changed this sync; the host reconstructs the rest from its copy.
+    wt_win = lax.dynamic_slice(wt_tab, (t_start, 0), (tw, n))
+    fr_win = lax.dynamic_slice(fr_tab, (t_start, 0), (tw, n))
 
     return jnp.concatenate([
         t_end[None].astype(jnp.int32), newly_count[None],
-        wt_tab.ravel(), fr_tab.ravel(),
-        rnd_b, wit_b.astype(jnp.int32), famous_merged.ravel(), rr, cts,
+        wt_win.ravel(), fr_win.ravel(),
+        rnd_b, wit_b.astype(jnp.int32), famous_merged.ravel(),
+        rr_u, cts_u,
     ])
 
 
@@ -943,7 +950,15 @@ class IncrementalEngine:
         # batch worth of events; a late fame decision can release a
         # backlog, detected post-pull (newly_count) and redone bigger.
         # _last_newly keeps the bucket sticky across bursty stretches.
-        cb = min(_pow2(max(2 * b_new, self._last_newly, 64)), cap0)
+        # (cb never needs to exceed the undecided window: newly-received
+        # events are a subset of it.)
+        cb = min(_pow2(max(2 * b_new, self._last_newly, 64)), cap0, au)
+        # Returned frontier-table window rows (only [t_start, t_start+tw)
+        # can change per sync); sized for the rows the sweep will
+        # rewrite — the re-swept existing rows [t0, rel_rows) plus the
+        # predicted growth — so a laggard catch-up (t0 far below
+        # rel_rows) does not force a guaranteed redo dispatch.
+        tw = _pow2(max(rel_rows - t0, 0) + self._last_growth + 2, 8)
 
         # Floor 64: each distinct rcap is a static shape of the fused
         # kernel, and on the tunneled runtime a recompile stalls a sync
@@ -969,6 +984,10 @@ class IncrementalEngine:
                 fam_rel[t] = self.famous[rho]
                 in_list_rel[t] = rho in undecided_set
             rx0 = rx0_known
+            # Clamp into a loop-local so an rcap-doubling redo reclamps
+            # from the intact prediction instead of a stale bound.
+            tw_i = min(tw, rcap)
+            t_start = min(t0, rcap - tw_i)
             packed_dev = _consensus_fused(
                 self._chain_la, self._chain_rb, chain_len_d, la, fd, rb,
                 self._chain_d, jnp.asarray(wt_tab), jnp.asarray(fr_tab),
@@ -978,7 +997,9 @@ class IncrementalEngine:
                 jnp.asarray(fam_rel), jnp.asarray(in_list_rel),
                 rank_up, jnp.int32(rx0),
                 jnp.int32(self._prev_first_undec), und_up, n_und,
-                n=n, sm=sm, rcap=rcap, bp=bp, rw=rw, iw=iw, cb=cb)
+                jnp.int32(t_start),
+                n=n, sm=sm, rcap=rcap, bp=bp, rw=rw, iw=iw, cb=cb,
+                tw=tw_i)
             # The one blocking device->host wait of the pass. With an
             # `unlocked` seam, the caller's lock is released here —
             # every input above was uploaded already, and everything
@@ -1006,7 +1027,12 @@ class IncrementalEngine:
             # exact spans now known from the pull. Likewise a
             # timestamp-bucket overflow (a fame decision released more
             # events than cb) redoes with the exact count.
-            rnd_b = packed[2 + 2 * rcap * n:2 + 2 * rcap * n + bp]
+            if t_end > t_start + tw_i:
+                # Returned-window overflow: the sweep advanced past the
+                # predicted row window — redo with the exact span.
+                tw = _pow2(max(t_end - t_start, 1), 8)
+                continue
+            rnd_b = packed[2 + 2 * tw_i * n:2 + 2 * tw_i * n + bp]
             valid_b = rnd_b >= 0
             min_new = int(rnd_b[valid_b].min()) if valid_b.any() else None
             r_hi = self.rho_min + t_end
@@ -1017,24 +1043,27 @@ class IncrementalEngine:
                     or newly_count > cb):
                 rw = _pow2(max(r_hi - rx0, 1))
                 iw = _pow2(max(r_hi - i0_true, 1))
-                cb = min(_pow2(max(newly_count, 64)), cap0)
+                cb = min(_pow2(max(newly_count, 64)), cap0, au)
                 continue
             break
 
         off = 2
-        tabs = packed[off:off + 2 * rcap * n].reshape(2, rcap, n)
-        off += 2 * rcap * n
-        wt_all = tabs[0][:t_end]
-        fr_all = tabs[1][:t_end]
+        tabs = packed[off:off + 2 * tw_i * n].reshape(2, tw_i, n)
+        off += 2 * tw_i * n
+        span_w = t_end - t_start
+        wt_all = np.concatenate(
+            [self._wt_table[:t_start], tabs[0][:span_w]], axis=0)
+        fr_all = np.concatenate(
+            [self._fr_table[:t_start], tabs[1][:span_w]], axis=0)
         rnd_b = packed[off:off + bp]
         off += bp
         wit_b = packed[off:off + bp]
         off += bp
         famous_merged = packed[off:off + rw * n].reshape(rw, n)
         off += rw * n
-        rr_np = packed[off:off + cap0]
-        off += cap0
-        cts_np = packed[off:]
+        rr_u_np = packed[off:off + au]
+        off += au
+        cts_u_np = packed[off:]
         _mark("consensus")
 
         active = (fr_all < chain_len0[None, :]).any(axis=1)
@@ -1094,11 +1123,13 @@ class IncrementalEngine:
                     delta.last_commited_round_events = int(
                         (self.rounds[:e] == rho - 1).sum())
 
-        newly = (rr_np >= 0) & (self.rr[:cap0] < 0)
-        newly[e:] = False
-        for i in np.nonzero(newly)[0]:
-            rr_i = int(rr_np[i])
-            rank = int(cts_np[i])
+        # rr/cts arrive per undecided-window lane; every lane with an
+        # assignment is newly received (the window is exactly the rr<0
+        # events of the snapshot).
+        for li in np.nonzero(rr_u_np[: len(und)] >= 0)[0]:
+            i = int(und[li])
+            rr_i = int(rr_u_np[li])
+            rank = int(cts_u_np[li])
             self.rr[i] = rr_i
             if rank == ZERO_TS_RANK:
                 self.cts_ns[i] = CTS_SENTINEL
