@@ -396,12 +396,12 @@ def _consensus_fused(chain_la, chain_rb_tab, chain_len, la, fd, rb_vec,
 
     # Only rows [t_start, t_start + tw) of the frontier tables can have
     # changed this sync; the host reconstructs the rest from its copy.
-    wt_win = lax.dynamic_slice(wt_tab, (t_start, 0), (tw, n))
-    fr_win = lax.dynamic_slice(fr_tab, (t_start, 0), (tw, n))
+    wt_ret = lax.dynamic_slice(wt_tab, (t_start, 0), (tw, n))
+    fr_ret = lax.dynamic_slice(fr_tab, (t_start, 0), (tw, n))
 
     return jnp.concatenate([
         t_end[None].astype(jnp.int32), newly_count[None],
-        wt_win.ravel(), fr_win.ravel(),
+        wt_ret.ravel(), fr_ret.ravel(),
         rnd_b, wit_b.astype(jnp.int32), famous_merged.ravel(),
         rr_u, cts_u,
     ])
@@ -1027,11 +1027,15 @@ class IncrementalEngine:
             # exact spans now known from the pull. Likewise a
             # timestamp-bucket overflow (a fame decision released more
             # events than cb) redoes with the exact count.
+            # All overflow checks read the pulled buffer (offsets use
+            # the tw_i actually dispatched), so a sync overflowing
+            # several windows enlarges them all before ONE redo.
+            redo = False
             if t_end > t_start + tw_i:
                 # Returned-window overflow: the sweep advanced past the
                 # predicted row window — redo with the exact span.
                 tw = _pow2(max(t_end - t_start, 1), 8)
-                continue
+                redo = True
             rnd_b = packed[2 + 2 * tw_i * n:2 + 2 * tw_i * n + bp]
             valid_b = rnd_b >= 0
             min_new = int(rnd_b[valid_b].min()) if valid_b.any() else None
@@ -1044,6 +1048,8 @@ class IncrementalEngine:
                 rw = _pow2(max(r_hi - rx0, 1))
                 iw = _pow2(max(r_hi - i0_true, 1))
                 cb = min(_pow2(max(newly_count, 64)), cap0, au)
+                redo = True
+            if redo:
                 continue
             break
 

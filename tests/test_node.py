@@ -80,6 +80,32 @@ def test_core_sync_pair():
     assert all(v == 1 for v in cores[0].known().values())
 
 
+def test_core_sync_tolerates_duplicates():
+    """A sync batch computed against a stale known-map overlaps events
+    the receiver already has (pulls and pushes run concurrently in the
+    live node); already-known events are skipped, the rest of the batch
+    lands, and the receiver's state matches a duplicate-free sync —
+    aborting on the first duplicate wedged nodes permanently."""
+    cores = init_cores(2)
+    synchronize_cores(cores, 0, 1, [b"a"])
+    # A stale diff: everything core 0 has, including what core 1
+    # already knows (known-map of a fresh peer).
+    stale_known = {pid: -1 for pid in cores[1].known()}
+    overlap = cores[0].to_wire(cores[0].diff(stale_known))
+    assert len(overlap) >= 1
+    before = cores[1].known()
+    cores[1].sync(overlap)  # must not raise
+    after = cores[1].known()
+    # Only core 1's own new head event was added; core 0's events were
+    # all duplicates and silently skipped.
+    for pid, idx in before.items():
+        assert after[pid] >= idx
+    assert sum(after.values()) == sum(before.values()) + 1
+    # State remains insertable: a clean follow-up round-trip works.
+    synchronize_cores(cores, 1, 0)
+    synchronize_cores(cores, 0, 1)
+
+
 def test_core_consensus_identical_order():
     """Scripted gossip between 3 cores converges to identical consensus
     order — reference core_test.go TestConsensus:354."""
