@@ -72,8 +72,10 @@ def cmd_run(args) -> int:
                 os.path.expanduser("~"), ".cache", "babble_tpu", "jax"))
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # 1s floor: trivial kernels recompile fast anyway, and
+        # persisting every one grows the cache dir without bound.
         jax.config.update(
-            "jax_persistent_cache_min_compile_time_secs", 0.0)
+            "jax_persistent_cache_min_compile_time_secs", 1.0)
 
     datadir = args.datadir
     key = PemKey(datadir).read_key()

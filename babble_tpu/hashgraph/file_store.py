@@ -163,6 +163,15 @@ class FileStore:
         ev.topological_index = row[1]
         return ev
 
+    def has_event(self, key: str) -> bool:
+        if self.inmem.has_event(key):
+            return True
+        with self._lock:
+            row = self._db.execute(
+                "SELECT 1 FROM events WHERE hex = ?", (key,)
+            ).fetchone()
+        return row is not None
+
     def set_event(self, event: Event) -> None:
         self.inmem.set_event(event)
         obj = json.loads(event.marshal())

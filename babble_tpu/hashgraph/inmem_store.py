@@ -43,6 +43,13 @@ class InmemStore:
             raise StoreError(StoreErrType.KEY_NOT_FOUND, key)
         return res
 
+    def has_event(self, key: str) -> bool:
+        # get (not contains): a membership hit must refresh LRU
+        # recency exactly like the get_event probe it replaces, or
+        # hot ancestors checked as duplicates age out early.
+        _, ok = self.event_cache.get(key)
+        return ok
+
     def set_event(self, event: Event) -> None:
         key = event.hex()
         known = self.event_cache.contains(key)

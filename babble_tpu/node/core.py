@@ -14,7 +14,6 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from .. import crypto
-from ..common import StoreError
 from ..hashgraph.block import Block
 from ..hashgraph.event import Event, WireEvent
 from ..hashgraph.graph import Hashgraph
@@ -187,12 +186,7 @@ class Core:
         other_head = ""
         for k, we in enumerate(unknown):
             ev = self.hg.read_wire_info(we)
-            try:
-                self.hg.store.get_event(ev.hex())
-                known = True
-            except StoreError:
-                known = False
-            if not known:
+            if not self.hg.store.has_event(ev.hex()):
                 self.insert_event(ev, False)
             if k == len(unknown) - 1:
                 other_head = ev.hex()

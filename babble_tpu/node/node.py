@@ -190,15 +190,22 @@ class Node:
                     # overlap without the pile-up (the reference's
                     # gossip rounds are effectively sequential).
                     if self._gossip_slots.acquire(blocking=False):
-                        proceed = self._pre_gossip()
-                        peer = (self.peer_selector.next()
-                                if proceed else None)
-                        if peer is not None:
-                            addr = peer.net_addr
-                            self.state.go_func(
-                                lambda: self._gossip_bounded(addr))
-                        else:
-                            self._gossip_slots.release()
+                        spawned = False
+                        try:
+                            proceed = self._pre_gossip()
+                            peer = (self.peer_selector.next()
+                                    if proceed else None)
+                            if peer is not None:
+                                addr = peer.net_addr
+                                self.state.go_func(
+                                    lambda: self._gossip_bounded(addr))
+                                spawned = True
+                        finally:
+                            # A slot leaked here (selector or thread
+                            # spawn raising) would permanently shrink
+                            # the 2-slot gossip budget.
+                            if not spawned:
+                                self._gossip_slots.release()
                 if not self.core.need_gossip():
                     self.control_timer.stop()
                 elif not self.control_timer.set:
