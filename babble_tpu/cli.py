@@ -101,7 +101,7 @@ def cmd_run(args) -> int:
         consensus_interval=(
             args.consensus_interval / 1000.0
             if args.consensus_interval is not None
-            else (0.05 if args.engine == "tpu" else 0.0)),
+            else (1.0 if args.engine == "tpu" else 0.0)),
         logger=logger,
     )
 
@@ -194,8 +194,9 @@ def build_parser() -> argparse.ArgumentParser:
     rn.add_argument("--consensus_interval", type=int, default=None,
                     help="min milliseconds between consensus passes "
                          "(0 = after every sync, the reference cadence; "
-                         "default 0 for --engine host, 50 for tpu so "
-                         "several syncs share one device pass)")
+                         "default 0 for --engine host, 1000 for tpu so "
+                         "many syncs share one device pass — each "
+                         "pass costs a device round trip)")
     rn.set_defaults(fn=cmd_run)
 
     vs = sub.add_parser("version", help="print version")

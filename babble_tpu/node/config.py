@@ -36,6 +36,15 @@ class Config:
     # batches — ordering is unaffected (consensus is deterministic in
     # the DAG, not in when it runs), only commit latency trades off.
     consensus_interval: float = 0.0
+    # Ingest flow control for the batched engine: when the engine's
+    # unprocessed-event backlog exceeds this, syncs/pushes/self-events
+    # wait (lock-free sleep) for the consensus worker to drain. Without
+    # it gossip can outrun consensus — the undecided window then grows
+    # past the LRU store's working set (evicting events FindOrder still
+    # needs) and the device round/fame windows balloon into recompiles.
+    # The reference needs no such bound because its gossip is fully
+    # serialized with RunConsensus (node/node.go:467-487).
+    engine_backlog_limit: int = 1024
     logger: logging.Logger = field(default_factory=_default_logger)
 
 

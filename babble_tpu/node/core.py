@@ -272,5 +272,13 @@ class Core:
     def get_last_commited_round_events_count(self) -> int:
         return self.hg.last_commited_round_events
 
+    def engine_backlog(self) -> int:
+        """Events appended but not yet folded by a consensus pass —
+        0 for the host engine (consensus runs inline with each sync)."""
+        engine = getattr(self.hg, "engine", None)
+        if engine is None:
+            return 0
+        return engine.backlog()
+
     def need_gossip(self) -> bool:
         return self.hg.pending_loaded_events > 0 or len(self.transaction_pool) > 0

@@ -253,7 +253,21 @@ class Node:
             except Exception as exc:  # noqa: BLE001 - keep the loop alive
                 self.logger.error("consensus pass failed: %s", exc)
 
+    def _throttle_ingest(self) -> None:
+        """Ingest flow control (engine_backlog_limit): wait — WITHOUT
+        the core lock — until the consensus worker drains the batched
+        engine's backlog. Bounds the undecided working set (LRU-store
+        safety) and the device round windows (recompile safety); a
+        no-op for the host engine, whose backlog is always 0."""
+        limit = self.conf.engine_backlog_limit
+        if limit <= 0:
+            return
+        while (self.core.engine_backlog() > limit
+               and not self._shutdown.is_set()):
+            time.sleep(0.005)
+
     def _pre_gossip(self) -> bool:
+        self._throttle_ingest()
         with self.core_lock:
             need = self.core.need_gossip() or self.state.is_starting()
             if not need:
@@ -309,6 +323,7 @@ class Node:
         if resp.sync_limit:
             return True, None
 
+        self._throttle_ingest()
         with self.core_lock:
             if self._shutdown.is_set():
                 raise TransportError("node is shutting down")
@@ -382,6 +397,7 @@ class Node:
     def _process_eager_sync_request(self, rpc: RPC, cmd: EagerSyncRequest) -> None:
         success = True
         err: Optional[Exception] = None
+        self._throttle_ingest()
         with self.core_lock:
             try:
                 self._sync(cmd.events)
