@@ -397,7 +397,16 @@ class Node:
     def _process_eager_sync_request(self, rpc: RPC, cmd: EagerSyncRequest) -> None:
         success = True
         err: Optional[Exception] = None
-        self._throttle_ingest()
+        # Never SLEEP here: this runs on the node's single background
+        # worker, and blocking it would stall read-only sync serving,
+        # tx intake, and block commits along with the push. Overload is
+        # signalled to the pusher instead (a failed push ends the
+        # peer's gossip round; it retries after its own throttle).
+        limit = self.conf.engine_backlog_limit
+        if limit > 0 and self.core.engine_backlog() > 4 * limit:
+            rpc.respond(EagerSyncResponse(self.id, False),
+                        TransportError("engine backlog over limit"))
+            return
         with self.core_lock:
             try:
                 self._sync(cmd.events)

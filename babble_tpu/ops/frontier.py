@@ -183,16 +183,19 @@ def frontier_chunk(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
     return wt_out, fr_out, act_out, wt_last, fr_last
 
 
-@functools.partial(jax.jit, static_argnames=("n", "sm", "rcap"))
-def frontier_sweep(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
-                   wt_tab, fr_tab, wt_prev, fr_prev, t0, rho_min,
-                   *, n, sm, rcap):
+def frontier_sweep_impl(chain_la, chain_rbase, chain_len, la, fd, rbase,
+                        chain, wt_tab, fr_tab, wt_prev, fr_prev, t0,
+                        rho_min, *, n, sm, rcap):
     """Single-dispatch frontier: run rounds rho_min+t for t in [t0, rcap)
     under a device while-loop until no chain has a candidate, writing
     into the [rcap, n] tables (rows >= t0 are overwritten; rows < t0 are
     the frozen warm-start prefix). Returns (wt_tab, fr_tab, t_end);
     t_end == rcap with activity still pending means the caller must
-    re-run with a larger bucket."""
+    re-run with a larger bucket.
+
+    Unjitted so callers already inside a trace can pass a lazy row-view
+    `fd` (any object supporting fd[ids] -> [len(ids), n], e.g.
+    incremental._FdRows) instead of a dense [E, n] array."""
     k_cap = chain_la.shape[1]
     step = make_round_step(chain_la, chain_rbase, chain_len, la, fd, rbase,
                            chain, n=n, sm=sm)
@@ -211,6 +214,10 @@ def frontier_sweep(chain_la, chain_rbase, chain_len, la, fd, rbase, chain,
     t_end, _, _, _, wt_tab, fr_tab = lax.while_loop(
         cond, body, (t0, jnp.bool_(True), wt_prev, fr_prev, wt_tab, fr_tab))
     return wt_tab, fr_tab, t_end
+
+
+frontier_sweep = functools.partial(jax.jit, static_argnames=(
+    "n", "sm", "rcap"))(frontier_sweep_impl)
 
 
 @functools.partial(jax.jit, static_argnames=("n",))

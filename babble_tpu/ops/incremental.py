@@ -217,32 +217,55 @@ def _tables_update(ranks, chain_la, chain_rb, la, rb, newtab, newpos,
     chain_rb = chain_rb.at[crows, pos].set(
         jnp.where(valid, rb_new, INT32_MAX), mode="drop")
 
-    # An event with la value v counts for every threshold t > v, i.e.
-    # t >= v + 1: bucket v+1 in a per-(chain, creator) histogram, then
-    # cnt[t] = cumsum(hist)[t]. Invalid lanes bucket to k — beyond the
-    # ranks slice — and drop out. Chunked over the creator axis so the
-    # hist + cumsum transients stay under the working-set bound (the
-    # resident ranks cube is already n^2·K; the transients must not
-    # triple that at large n·K).
-    v = jnp.where(valid[:, :, None], jnp.clip(la_new + 1, 0, k), k)
-    ic = max(min(_FD_CHUNK_ELEMS // max(n * (k + 1), 1), n), 1)
+    # Broadcast-compare-reduce: delta[c, i, t] = #{new j on chain c :
+    # la_new[c, j, i] < t}. FLOP-wise this is O(batch·n·K) against the
+    # histogram+cumsum's O(n^2·K), but it is pure compare+sum — XLA
+    # fuses it into a stream with no scatter and no scan, and on TPU
+    # the scatter-add histogram serialized into the per-sync bottleneck
+    # (measured 347 ms/pass at n=1024 vs ~40 ms for this form).
+    # Invalid lanes compare as INT32_MAX and never count; la = -1
+    # counts for every t >= 0, matching clip(la+1, 0, k) bucketing.
+    la_eff = jnp.where(valid[:, :, None], la_new, INT32_MAX)  # [n, m, n]
+    ic = max(min(_FD_CHUNK_ELEMS // max(m * k, 1), n), 1)
     while n % ic:
         ic -= 1
     nchunks = n // ic
-    c_ix = jnp.broadcast_to(jnp.arange(n)[:, None, None], (n, m, ic))
-    i_ix = jnp.broadcast_to(jnp.arange(ic)[None, None, :], (n, m, ic))
+    t_vec = jnp.arange(k, dtype=jnp.int32)
 
     def chunk(g, ranks):
         i0c = g * ic
-        v_g = lax.dynamic_slice(v, (0, 0, i0c), (n, m, ic))
-        hist = jnp.zeros((n, ic, k + 1), jnp.int32).at[
-            c_ix, i_ix, v_g].add(1)
-        delta = jnp.cumsum(hist, axis=2)[:, :, :k]
+        la_g = lax.dynamic_slice(la_eff, (0, 0, i0c), (n, m, ic))
+        cmp = la_g[:, :, :, None] < t_vec  # [n, m, ic, k], fused
+        delta = cmp.sum(1, dtype=jnp.int32)  # [n, ic, k]
         blk = lax.dynamic_slice(ranks, (0, i0c, 0), (n, ic, k)) + delta
         return lax.dynamic_update_slice(ranks, blk, (0, i0c, 0))
 
     ranks = lax.fori_loop(0, nchunks, chunk, ranks)
     return ranks, chain_la, chain_rb
+
+
+class _FdRows:
+    """Lazy row view of the first-descendant matrix: fd[ids] -> the
+    same [len(ids), n] rows _fd_from_ranks would give, gathered straight
+    from the resident rank cube. Every consumer of fd (frontier sweep,
+    fame, consensus timestamps) reads row gathers only, so the dense
+    [cap, n] materialization (512 MB/pass at the n=1024 north star) is
+    never built."""
+
+    def __init__(self, ranks, chain_len, creator, index):
+        self.ranks = ranks
+        self.chain_len = chain_len
+        self.creator = creator
+        self.index = index
+        self.k = ranks.shape[2]
+
+    def __getitem__(self, ids):
+        ca = self.creator[ids]
+        ix = self.index[ids]
+        ia = jnp.clip(ix, 0, self.k - 1)
+        raw = jnp.moveaxis(self.ranks[:, ca, ia], 0, -1)  # [*S, n]
+        fd = jnp.where(raw < self.chain_len, raw, INT32_MAX)
+        return jnp.where((ix >= 0)[..., None], fd, INT32_MAX)
 
 
 @functools.partial(jax.jit, static_argnames=("n",))
@@ -264,7 +287,7 @@ def _fd_from_ranks(ranks, chain_len, creator, index, *, n):
 @functools.partial(
     jax.jit,
     static_argnames=("n", "sm", "rcap", "bp", "rw", "iw", "cb", "tw"))
-def _consensus_fused(chain_la, chain_rb_tab, chain_len, la, fd, rb_vec,
+def _consensus_fused(chain_la, chain_rb_tab, chain_len, la, ranks, rb_vec,
                      chain, wt_tab, fr_tab, wt_prev, fr_prev, t0, rho_min,
                      self_parent, creator, index, coin, e0, e1,
                      rounds_prev, rr_prev, fam_rel, in_list_rel,
@@ -292,22 +315,24 @@ def _consensus_fused(chain_la, chain_rb_tab, chain_len, la, fd, rb_vec,
     Packed layout (the tunneled runtime charges ~119ms per pull PLUS
     ~100ms/MB, so every plane is window-sized, never E- or cap-sized):
     [t_end, newly_count, wt_win(tw*n), fr_win(tw*n), new_rounds(bp),
-    new_wit(bp), famous_merged(rw*n), rr_u(au), cts_hi(au), cts_lo(au)]
-    where wt/fr_win are the swept table rows [t_start, t_start+tw) (the
-    only rows that can have changed) and rr_u/cts_* are per-lane results
-    for the host's undecided-event window (consensus timestamps as
-    split-int64 pairs, see _ts_split).
+    new_wit(bp), famous_merged(rw*n), sel_l(cb), rr_sel(cb),
+    cts_hi(cb), cts_lo(cb)] where wt/fr_win are the swept table rows
+    [t_start, t_start+tw) (the only rows that can have changed) and the
+    cb-compacted tail carries the newly-received lanes: sel_l[j] for
+    j < newly_count is an undecided-window lane index, with its
+    round-received and split-int64 consensus timestamp (see _ts_split)
+    in the matching positions of the other three planes.
 
     Besides the packed pull, the kernel returns updated `rounds` and
     `rr` DEVICE CARRIES (rounds_prev with the batch's rounds written,
     rr_prev with this sync's assignments scattered) — the host commits
     them after a successful pull so the next pass re-uploads neither.
     """
-    e = rounds_prev.shape[0]
     k = chain_th.shape[1]
+    fd = _FdRows(ranks, chain_len, creator, index)
 
     # 1. Witness frontier.
-    wt_tab, fr_tab, t_end = frontier.frontier_sweep(
+    wt_tab, fr_tab, t_end = frontier.frontier_sweep_impl(
         chain_la, chain_rb_tab, chain_len, la, fd, rb_vec, chain,
         wt_tab, fr_tab, wt_prev, fr_prev, t0, rho_min,
         n=n, sm=sm, rcap=rcap)
@@ -340,7 +365,7 @@ def _consensus_fused(chain_la, chain_rb_tab, chain_len, la, fd, rb_vec,
     famous_prev_win = jnp.where(row_ok[:, None], fam_rel[t_wc], 0)
     in_list_win = row_ok & in_list_rel[t_wc]
 
-    famous_comp = kernels.decide_fame(
+    famous_comp = kernels.decide_fame_impl(
         wt_win, la, fd, index, coin, n=n, sm=sm, r=rw)
     wt_valid_f = wt_win >= 0
     mergeable = (
@@ -442,12 +467,12 @@ def _consensus_fused(chain_la, chain_rb_tab, chain_len, la, fd, rb_vec,
     pick = (s_cnt // 2)[:, None]
     med_hi = jnp.take_along_axis(s_hi, pick, axis=1)[:, 0]
     med_lo = jnp.take_along_axis(s_lo, pick, axis=1)[:, 0]
-    # Scatter back to lanes; non-newly lanes keep the ZERO sentinel.
-    sel_scatter = jnp.where(live, sel_l, au)
-    cts_hi_u = jnp.full((au,), ZERO_TS_HI, jnp.int32).at[sel_scatter].set(
-        jnp.where(live, med_hi, ZERO_TS_HI), mode="drop")
-    cts_lo_u = jnp.zeros((au,), jnp.int32).at[sel_scatter].set(
-        jnp.where(live, med_lo, 0), mode="drop")
+    # Results ride home cb-compacted: the first newly_count entries of
+    # sel_l are exactly the newly-received lanes (stable argsort), so
+    # the pull carries [cb] lanes+rr+cts instead of three au-wide
+    # planes — at n=1024 the undecided window is tens of thousands of
+    # lanes and this saves megabytes per pull.
+    rr_sel = rr_u[sel_l]
 
     # Post-pass device carries: the batch's rounds and this sync's rr
     # assignments stay resident, so the next pass uploads neither. Pad
@@ -465,7 +490,7 @@ def _consensus_fused(chain_la, chain_rb_tab, chain_len, la, fd, rb_vec,
         t_end[None].astype(jnp.int32), newly_count[None],
         wt_ret.ravel(), fr_ret.ravel(),
         rnd_b, wit_b.astype(jnp.int32), famous_merged.ravel(),
-        rr_u, cts_hi_u, cts_lo_u,
+        sel_l.astype(jnp.int32), rr_sel, med_hi, med_lo,
     ])
     return packed, rounds_all, rr_all
 
@@ -977,8 +1002,10 @@ class IncrementalEngine:
                     n=n, m=self._new_m)
                 self._e_counted = e
                 self._len_counted = chain_len0.copy()
-            fd = _fd_from_ranks(self._ranks, chain_len_d, cr_d, idx_d, n=n)
-            _mark("fd", fd)
+            _mark("fd_fold", self._ranks)
+            # fd is consumed as lazy row gathers from the rank cube
+            # inside the fused kernel (_FdRows) — no [cap, n]
+            # materialization.
 
             # 3-6. Frontier, new-event rounds, fame, and round-received in
             # ONE device dispatch with ONE packed pull (_consensus_fused):
@@ -1036,7 +1063,10 @@ class IncrementalEngine:
             # events never change, so the kernel's per-round pass compares
             # against this compacted id set instead of all E events.
             und = np.nonzero(self.rr[:e] < 0)[0].astype(np.int32)
-            au = _pow2(len(und), 2048)
+            # x4 buckets: at the n=1024 north star the undecided window
+            # grows monotonically to ~cap/2, and pow2 breathing would
+            # recompile the fused kernel at every doubling.
+            au = _pow4(len(und), 4096)
             und_p = np.zeros(au, np.int32)
             und_p[: len(und)] = und
             und_up = jnp.asarray(und_p)
@@ -1074,7 +1104,12 @@ class IncrementalEngine:
             # through many pow2 sizes — each a compile. The floors pin
             # them to their realistic ceiling where that is cheap (the
             # arrays scale with n) and stay tight at large n.
-            w_floor = max(64, min(256, (1 << 13) // n))
+            # Large n => few, wide rounds: the fame step is a
+            # [n, n]@[n, W*n] contraction per row, so an oversized W
+            # floor multiplies real FLOPs there; small n => fast, many
+            # rounds, where a big floor only pads cheap tiny rows but
+            # saves a compile per pow2 step.
+            w_floor = max(16, min(256, (1 << 13) // n))
             rw = iw = _pow2(
                 max(self.rho_min + rel_rows - rx0_known,
                     self.rho_min + rel_rows - i0_known,
@@ -1089,11 +1124,14 @@ class IncrementalEngine:
             # into the cb compile dimension; a burst costs one redo and
             # then sticks via _last_newly.)
             cb = min(_pow2(max(self._last_newly, 1024)), cap0, au)
-            # Returned frontier-table window rows share W (rw covers
-            # rel_rows - t0 by construction, so the sweep's rewritten
-            # span fits; a laggard catch-up overflowing it costs one
-            # redo at the exact span).
-            tw = rw
+            # Returned frontier-table rows: their own pow2 size with a
+            # large-n floor below W — at n=1024 the [tw, n] x2 planes
+            # dominate the pull, and the actually-rewritten span is a
+            # handful of rows; at small n the floor equals W's, so no
+            # extra compile combo appears where W already breathes.
+            tw_floor = max(16, min(w_floor, (1 << 14) // n))
+            tw = min(rw, _pow2(
+                max(rel_rows - t0, 1) + growth, tw_floor))
 
             # Floor 64: each distinct rcap is a static shape of the fused
             # kernel, and on the tunneled runtime a recompile stalls a sync
@@ -1125,7 +1163,8 @@ class IncrementalEngine:
                 t_start = min(t0, rcap - tw_i)
                 _t_stage = _t()
                 packed_dev, rounds_out, rr_out = _consensus_fused(
-                    self._chain_la, self._chain_rb, chain_len_d, la, fd, rb,
+                    self._chain_la, self._chain_rb, chain_len_d, la,
+                    self._ranks, rb,
                     self._chain_d, jnp.asarray(wt_tab), jnp.asarray(fr_tab),
                     wt_prev, fr_prev, jnp.int32(t0), jnp.int32(self.rho_min),
                     self._sp_d, cr_d, idx_d, coin_d,
@@ -1172,8 +1211,8 @@ class IncrementalEngine:
                 if t_end > t_start + tw_i:
                     # Returned-window overflow: the sweep advanced past the
                     # predicted row window — redo with the exact span.
-                    rw = iw = tw = _pow2(
-                        max(t_end - t_start, rw + 1), w_floor)
+                    tw = _pow2(max(t_end - t_start, tw_i + 1), tw_floor)
+                    rw = iw = max(rw, _pow2(tw, w_floor))
                     redo = True
                 rnd_b = packed[2 + 2 * tw_i * n:2 + 2 * tw_i * n + bp]
                 valid_b = rnd_b >= 0
@@ -1184,7 +1223,7 @@ class IncrementalEngine:
                     i0_true = min(i0_true, min_new + 1)
                 if (r_hi - rx0 > rw or r_hi - i0_true > iw
                         or newly_count > cb):
-                    rw = iw = tw = _pow2(
+                    rw = iw = _pow2(
                         max(r_hi - rx0, r_hi - i0_true, rw), w_floor)
                     cb = min(_pow2(max(newly_count, 1024)), cap0, au)
                     redo = True
@@ -1214,10 +1253,12 @@ class IncrementalEngine:
         off += bp
         famous_merged = packed[off:off + rw * n].reshape(rw, n)
         off += rw * n
-        rr_u_np = packed[off:off + au]
-        off += au
-        cts_hi_np = packed[off:off + au]
-        off += au
+        sel_np = packed[off:off + cb]
+        off += cb
+        rr_sel_np = packed[off:off + cb]
+        off += cb
+        cts_hi_np = packed[off:off + cb]
+        off += cb
         cts_lo_np = packed[off:]
         # "consensus" is the host-side share of the fused stage:
         # window staging + unpack, EXCLUDING the dispatch-block and the
@@ -1287,19 +1328,20 @@ class IncrementalEngine:
                     delta.last_commited_round_events = int(
                         (self.rounds[:e] == rho - 1).sum())
 
-        # rr/cts arrive per undecided-window lane; every lane with an
-        # assignment is newly received (the window is exactly the rr<0
-        # events of the snapshot).
-        for li in np.nonzero(rr_u_np[: len(und)] >= 0)[0]:
+        # The cb-compacted tail: entries [0, newly_count) are the newly
+        # received lanes in ascending lane (= event id) order — the
+        # same order the au-wide scan used to produce.
+        for j in range(newly_count):
+            li = int(sel_np[j])
             i = int(und[li])
-            rr_i = int(rr_u_np[li])
-            hi = int(cts_hi_np[li])
+            rr_i = int(rr_sel_np[j])
+            hi = int(cts_hi_np[j])
             self.rr[i] = rr_i
             if hi == ZERO_TS_HI:
                 self.cts_ns[i] = CTS_SENTINEL
                 ns = ZERO_TIME_NS
             else:
-                ns = _ts_join(hi, int(cts_lo_np[li]))
+                ns = _ts_join(hi, int(cts_lo_np[j]))
                 self.cts_ns[i] = ns
             delta.new_received.append((int(i), rr_i, ns))
         delta.last_consensus_round = self.last_consensus_round

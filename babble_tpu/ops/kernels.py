@@ -218,8 +218,7 @@ def compute_rounds(
     return rounds[:e], wit[:e], wt[:r]
 
 
-@functools.partial(jax.jit, static_argnames=("n", "sm", "r"))
-def decide_fame(wt, la, fd, index, coin, *, n, sm, r):
+def decide_fame_impl(wt, la, fd, index, coin, *, n, sm, r):
     """Virtual voting — reference DecideFame (hashgraph.go:649-730).
 
     One sweep over voting rounds j: round-j witnesses vote on every
@@ -301,6 +300,10 @@ def decide_fame(wt, la, fd, index, coin, *, n, sm, r):
 
     famous, _ = lax.fori_loop(1, r, step, (famous0, votes0))
     return famous
+
+
+decide_fame = functools.partial(jax.jit, static_argnames=(
+    "n", "sm", "r"))(decide_fame_impl)
 
 
 @functools.partial(jax.jit, static_argnames=("n", "r"))

@@ -28,6 +28,8 @@ def main(n=4, e_tot=20000, bs=256, cap=65536, kcap=65536, timers=True):
         dt = time.perf_counter() - t0
         per.append((dt, dict(eng.phase_ns)))
         k = hi
+    total = sum(d for d, _ in per)
+    print(f"   total run-pass wall: {total:.1f}s")
     # steady state = last half
     half = per[len(per) // 2:]
     med = np.median([d for d, _ in half])

@@ -3,7 +3,7 @@ but dumps per-node phase breakdowns so we can see where the one core goes."""
 import os, sys, time, threading, json
 sys.path.insert(0, "/root/repo")
 
-def main(engine="tpu", n_nodes=4, warm_s=150.0, window_s=45.0, interval=0.25,
+def main(engine="tpu", n_nodes=4, warm_s=150.0, window_s=45.0, interval=1.0,
          gate=1500):
     import jax as _jax
     CACHE_DIR = os.path.join(os.path.expanduser("~"), ".cache", "babble_tpu", "jax")
@@ -63,11 +63,11 @@ def main(engine="tpu", n_nodes=4, warm_s=150.0, window_s=45.0, interval=0.25,
             time.sleep(0.5)
         print(f"[exp] warm done at +{time.monotonic()-t_start:.1f}s committed={committed()}", flush=True)
         # snapshot phase counters
-        snap0 = [dict((k, list(v)) for k, v in nd.core.phase_ns.items()) for nd in nodes]
+        snap0 = [dict((k, list(v)) for k, v in list(nd.core.phase_ns.items())) for nd in nodes]
         c0, t0 = committed(), time.monotonic()
         time.sleep(window_s)
         c1, t1 = committed(), time.monotonic()
-        snap1 = [dict((k, list(v)) for k, v in nd.core.phase_ns.items()) for nd in nodes]
+        snap1 = [dict((k, list(v)) for k, v in list(nd.core.phase_ns.items())) for nd in nodes]
     finally:
         stop.set()
         for nd in nodes:
@@ -103,7 +103,7 @@ if __name__ == "__main__":
     ap.add_argument("--n", type=int, default=4)
     ap.add_argument("--warm", type=float, default=150.0)
     ap.add_argument("--window", type=float, default=45.0)
-    ap.add_argument("--interval", type=float, default=0.25)
+    ap.add_argument("--interval", type=float, default=1.0)
     ap.add_argument("--gate", type=int, default=1500)
     a = ap.parse_args()
     main(a.engine, a.n, a.warm, a.window, a.interval, a.gate)
