@@ -66,3 +66,42 @@ class LRU:
 
     def __len__(self) -> int:
         return len(self._items)
+
+
+class Memo:
+    """Bounded memo table for pure, recomputable functions (ancestry,
+    strongly-see, rounds). Implements only the get/add/contains subset
+    of LRU's surface (no eviction signal, no on_evict), as a flat dict
+    with clear-on-overflow: memo hits sat on the host consensus hot
+    path (1.8M lookups per RunConsensus at n=16), where LRU's per-hit
+    move_to_end cost bought nothing — evicting everything and
+    recomputing on demand is cheaper than tracking recency."""
+
+    __slots__ = ("size", "_items")
+
+    def __init__(self, size: int):
+        if size <= 0:
+            raise ValueError("memo: must provide a positive size")
+        self.size = size
+        self._items: dict = {}
+
+    def add(self, key, value) -> bool:
+        if len(self._items) >= self.size and key not in self._items:
+            self._items.clear()
+        self._items[key] = value
+        return False
+
+    def get(self, key):
+        v = self._items.get(key, _MISS)
+        if v is _MISS:
+            return None, False
+        return v, True
+
+    def contains(self, key) -> bool:
+        return key in self._items
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+_MISS = object()

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional
 
-from ..common import LRU, StoreError, StoreErrType, is_store_err
+from ..common import LRU, Memo, StoreError, StoreErrType, is_store_err
 from ..gojson import Timestamp, ZERO_TIME
 from .block import Block
 from .event import Event, EventBody, EventCoordinates, WireEvent
@@ -76,13 +76,18 @@ class Hashgraph:
         self.topological_index = 0
         self.super_majority = 2 * len(participants) // 3 + 1
 
-        cache_size = store.cache_size()
-        self._ancestor_cache = LRU(cache_size)
-        self._self_ancestor_cache = LRU(cache_size)
-        self._oldest_self_ancestor_cache = LRU(cache_size)
-        self._strongly_see_cache = LRU(cache_size)
-        self._parent_round_cache = LRU(cache_size)
-        self._round_cache = LRU(cache_size)
+        self._init_memo_caches()
+
+    def _init_memo_caches(self) -> None:
+        # Memo (not LRU): these cache PURE functions of the DAG, so
+        # eviction policy affects only speed — see common/lru.py.
+        cache_size = self.store.cache_size()
+        self._ancestor_cache = Memo(cache_size)
+        self._self_ancestor_cache = Memo(cache_size)
+        self._oldest_self_ancestor_cache = Memo(cache_size)
+        self._strongly_see_cache = Memo(cache_size)
+        self._parent_round_cache = Memo(cache_size)
+        self._round_cache = Memo(cache_size)
 
     # -- reachability ------------------------------------------------------
 
@@ -541,8 +546,15 @@ class Hashgraph:
                     tr = RoundInfo()
 
                 # Skip until the round is fully decided and all earlier
-                # rounds are too (hashgraph.go:762-764).
-                if not (tr.witnesses_decided() and self.undecided_rounds[0] > i):
+                # rounds are too (hashgraph.go:762-764). Once i reaches
+                # the first undecided round the gate fails for EVERY
+                # larger i (it is monotone in i), so scanning on is
+                # provably all no-ops — break instead (the reference
+                # continues, to the same outcome, at O(last_round) per
+                # event).
+                if self.undecided_rounds and self.undecided_rounds[0] <= i:
+                    break
+                if not tr.witnesses_decided():
                     continue
 
                 fws = tr.famous_witnesses()
@@ -648,13 +660,7 @@ class Hashgraph:
         self.pending_loaded_events = 0
         self.topological_index = 0
 
-        cache_size = self.store.cache_size()
-        self._ancestor_cache = LRU(cache_size)
-        self._self_ancestor_cache = LRU(cache_size)
-        self._oldest_self_ancestor_cache = LRU(cache_size)
-        self._strongly_see_cache = LRU(cache_size)
-        self._parent_round_cache = LRU(cache_size)
-        self._round_cache = LRU(cache_size)
+        self._init_memo_caches()
 
     def get_frame(self) -> Frame:
         last_consensus_round_index = (

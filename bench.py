@@ -306,7 +306,7 @@ def host_engine_events_per_sec(n_peers, n_events, seed=7):
 
 
 def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
-                                window_s=30.0):
+                                window_s=30.0, interval=None):
     """Throughput of a live localhost testnet: N real nodes (threads,
     inmem transport, signed events, full sync protocol) bombarded with
     transactions; returns committed consensus events/sec during a
@@ -350,20 +350,28 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
     for i, (key, peer) in enumerate(entries):
         conf = test_config(heartbeat=0.01, cache_size=100000)
         conf.engine = engine
-        if engine == "tpu":
-            # Batch many syncs per device pass: gossip stays at wire
-            # speed, the engine drains the backlog in device-sized
-            # batches. Each pass costs a ~110 ms tunnel round trip and
-            # 4 nodes share the one chip, so a 1 s cadence keeps the
-            # tunnel under 50% duty; 0.25 s oversubscribed it and
-            # A/B'd 3.5x slower (68 vs 240 ev/s).
-            conf.consensus_interval = 1.0
+        # Batch many syncs per consensus pass. For the tpu engine each
+        # pass costs a ~110 ms tunnel round trip and the nodes share
+        # one chip, so a 1 s cadence keeps the tunnel under 50% duty
+        # (0.25 s oversubscribed it, A/B 68 vs 240 ev/s). For the
+        # 16-node host testnet the same batching amortizes the
+        # undecided-round rescan (A/B 52 vs 78 ev/s); the 4-node host
+        # testnet keeps the reference's per-sync cadence.
+        if interval is None:
+            interval = 1.0 if engine == "tpu" else 0.0
+        conf.consensus_interval = interval
         node = Node(conf, i, key, peers, InmemStore(participants, 100000),
                     transports[i], InmemAppProxy())
         node.init()
         nodes.append(node)
 
     stop = threading.Event()
+    # One process, dozens of pure-Python threads: the default 5 ms GIL
+    # switch interval thrashes caches (A/B at 16 nodes: 78 -> 102 ev/s
+    # at 100 ms). Restored in the finally below.
+    import sys as _sys
+    old_switch = _sys.getswitchinterval()
+    _sys.setswitchinterval(0.1)
 
     def bombard():
         i = 0
@@ -395,6 +403,7 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
         time.sleep(window_s)
         c1, t1 = committed(), time.monotonic()
     finally:
+        _sys.setswitchinterval(old_switch)
         stop.set()
         for nd in nodes:
             nd.shutdown()
@@ -617,7 +626,8 @@ def child():
         if _budget_left() > 150:
             try:
                 node_eps = node_testnet_events_per_sec(
-                    engine="host", n_nodes=16, warm_s=45.0, window_s=30.0)
+                    engine="host", n_nodes=16, warm_s=45.0, window_s=30.0,
+                    interval=1.0)
                 log(f"  16-node --engine host testnet: {node_eps:,.1f} "
                     f"committed events/s")
                 payload["node16_events_per_s"] = round(node_eps, 1)

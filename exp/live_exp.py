@@ -2,6 +2,8 @@
 but dumps per-node phase breakdowns so we can see where the one core goes."""
 import os, sys, time, threading, json
 sys.path.insert(0, "/root/repo")
+if os.environ.get("SWITCH_IV"):
+    sys.setswitchinterval(float(os.environ["SWITCH_IV"]))
 
 def main(engine="tpu", n_nodes=4, warm_s=150.0, window_s=45.0, interval=1.0,
          gate=1500):
@@ -33,8 +35,7 @@ def main(engine="tpu", n_nodes=4, warm_s=150.0, window_s=45.0, interval=1.0,
     for i, (key, peer) in enumerate(entries):
         conf = test_config(heartbeat=0.01, cache_size=100000)
         conf.engine = engine
-        if engine == "tpu":
-            conf.consensus_interval = interval
+        conf.consensus_interval = interval
         node = Node(conf, i, key, peers, InmemStore(participants, 100000),
                     transports[i], InmemAppProxy())
         node.init()
