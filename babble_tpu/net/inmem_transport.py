@@ -11,6 +11,8 @@ import threading
 import uuid
 
 from .transport import (
+    FastForwardRequest,
+    FastForwardResponse,
     RPC,
     EagerSyncRequest,
     EagerSyncResponse,
@@ -48,6 +50,13 @@ class InmemTransport:
     def eager_sync(self, target: str, args: EagerSyncRequest) -> EagerSyncResponse:
         resp = self._make_rpc(target, args)
         if not isinstance(resp, EagerSyncResponse):
+            raise TransportError(f"unexpected response type {type(resp)}")
+        return resp
+
+    def fast_forward(self, target: str,
+                     args: FastForwardRequest) -> FastForwardResponse:
+        resp = self._make_rpc(target, args)
+        if not isinstance(resp, FastForwardResponse):
             raise TransportError(f"unexpected response type {type(resp)}")
         return resp
 

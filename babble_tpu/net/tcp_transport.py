@@ -1,7 +1,8 @@
 """TCP transport with the reference wire protocol.
 
 Reference net/net_transport.go:33-46,147-390 + tcp_transport.go:48-91:
-- request: 1 framing byte (0x00 Sync, 0x01 EagerSync) + JSON body
+- request: 1 framing byte (0x00 Sync, 0x01 EagerSync, 0x02 FastForward)
+  + JSON body
 - response: JSON error string ("" = ok) + JSON payload
 - pooled outbound connections per target, capped at max_pool
 - a listener thread accepts connections; each connection gets a handler
@@ -22,6 +23,8 @@ import threading
 from typing import Dict, List, Optional
 
 from .transport import (
+    FastForwardRequest,
+    FastForwardResponse,
     RPC,
     EagerSyncRequest,
     EagerSyncResponse,
@@ -33,6 +36,7 @@ from .transport import (
 
 RPC_SYNC = 0x00
 RPC_EAGER_SYNC = 0x01
+RPC_FAST_FORWARD = 0x02
 
 
 def _b64_bytes(obj):
@@ -116,6 +120,11 @@ class TCPTransport:
         out = self._generic_rpc(target, RPC_EAGER_SYNC, args.to_dict())
         return EagerSyncResponse.from_dict(out)
 
+    def fast_forward(self, target: str,
+                     args: FastForwardRequest) -> FastForwardResponse:
+        out = self._generic_rpc(target, RPC_FAST_FORWARD, args.to_dict())
+        return FastForwardResponse.from_dict(out)
+
     def close(self) -> None:
         self._shutdown.set()
         try:
@@ -188,6 +197,8 @@ class TCPTransport:
                     cmd = SyncRequest.from_dict(body)
                 elif t[0] == RPC_EAGER_SYNC:
                     cmd = EagerSyncRequest.from_dict(body)
+                elif t[0] == RPC_FAST_FORWARD:
+                    cmd = FastForwardRequest.from_dict(body)
                 else:
                     conn.send_json(f"unknown rpc type {t[0]}")
                     conn.send_json({})

@@ -92,6 +92,49 @@ class EagerSyncResponse:
 
 
 @dataclass
+class FastForwardRequest:
+    """Fast-sync: ask a peer for its current Frame (roots + events).
+    The reference stops at a stub here (node/node.go:432-441); this
+    completes the intended flow using GetFrame/Reset
+    (hashgraph.go:879-1002)."""
+
+    from_id: int
+
+    def to_dict(self) -> dict:
+        return {"FromID": self.from_id}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FastForwardRequest":
+        return cls(from_id=d["FromID"])
+
+
+@dataclass
+class FastForwardResponse:
+    """Frame payload: roots as Root.to_dict() maps, events as full
+    Go-JSON event objects (signatures included — the receiver
+    re-verifies on insert)."""
+
+    from_id: int
+    roots: Dict[str, dict] = field(default_factory=dict)
+    events: List[dict] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "FromID": self.from_id,
+            "Roots": self.roots,
+            "Events": self.events,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FastForwardResponse":
+        return cls(
+            from_id=d["FromID"],
+            roots=d.get("Roots") or {},
+            events=d.get("Events") or [],
+        )
+
+
+@dataclass
 class RPCResponse:
     response: object
     error: Optional[Exception] = None
@@ -118,5 +161,9 @@ class Transport(Protocol):
     def sync(self, target: str, args: SyncRequest) -> SyncResponse: ...
 
     def eager_sync(self, target: str, args: EagerSyncRequest) -> EagerSyncResponse: ...
+
+    def fast_forward(
+        self, target: str, args: FastForwardRequest
+    ) -> FastForwardResponse: ...
 
     def close(self) -> None: ...

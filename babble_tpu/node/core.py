@@ -14,6 +14,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from .. import crypto
+from ..common import StoreError
 from ..hashgraph.block import Block
 from ..hashgraph.event import Event, WireEvent
 from ..hashgraph.graph import Hashgraph
@@ -122,6 +123,9 @@ class Core:
         """Replay a persistent store and recover head/seq — reference
         node/core.go:88-120."""
         self.hg.bootstrap()
+        self._recover_head_and_seq()
+
+    def _recover_head_and_seq(self) -> None:
         last, is_root = self.hg.store.last_from(self.hex_id())
         if is_root:
             root = self.hg.store.get_root(self.hex_id())
@@ -131,6 +135,38 @@ class Core:
             last_event = self.hg.store.get_event(last)
             self.head = last
             self.seq = last_event.index()
+
+    def fast_forward(self, roots, events: List[Event]) -> None:
+        """Fast-sync: reset to a peer's Frame and replay its events,
+        then recover our head/seq from the reset store. Completes the
+        flow the reference leaves as a stub (node/node.go:432-441) on
+        top of GetFrame/Reset (hashgraph.go:879-1002); signatures are
+        re-verified by insert_event, so a malicious frame cannot forge
+        events. Both engines support Reset (the device engine rebuilds
+        with offset chain bases, tpu_graph.reset)."""
+        self.hg.reset(roots)
+        try:
+            for ev in events:
+                # Recompute wire coordinates against the reset store
+                # (they are not part of the Go-JSON body the frame
+                # ships) so this node's diffs serve resolvable wire
+                # events — best-effort: an event whose other-parent
+                # lies OUTSIDE the frame (Root.others) cannot be
+                # expressed in the reference's wire format at all (its
+                # own SetWireInfo errors there, hashgraph.go:532-567).
+                # Such events are pre-frame history: any peer missing
+                # them is itself past SyncLimit and will fast-sync
+                # rather than pull them from us.
+                try:
+                    self.insert_event(ev, True)
+                except StoreError:
+                    self.insert_event(ev, False)
+        finally:
+            # Even if a (malicious/corrupt) frame event aborts the
+            # replay, head/seq must track the RESET store — stale ones
+            # would wedge every later self-event and sync.
+            self.transaction_pool = []
+            self._recover_head_and_seq()
 
     def sign_and_insert_self_event(self, event: Event) -> None:
         event.sign(self.key)

@@ -29,6 +29,8 @@ from ..net.peer import Peer
 from ..net.transport import (
     EagerSyncRequest,
     EagerSyncResponse,
+    FastForwardRequest,
+    FastForwardResponse,
     RPC,
     SyncRequest,
     SyncResponse,
@@ -89,6 +91,7 @@ class Node:
         self.start_time = time.monotonic()
         self.sync_requests = 0
         self.sync_errors = 0
+        self.fast_forwards = 0
         self._stats_lock = threading.Lock()  # counters hit by gossip + RPC threads
 
     # -- lifecycle ---------------------------------------------------------
@@ -357,8 +360,34 @@ class Node:
             self.core.run_consensus()
 
     def _fast_forward(self) -> None:
-        # Reference stub (node/node.go:432-441): fast-sync from a Frame
-        # is unfinished upstream; drop straight back to Babbling.
+        """CatchingUp: pull a Frame from a peer and reset+replay
+        instead of re-gossiping history. The reference leaves this as a
+        stub (node/node.go:432-441); both engines here support
+        GetFrame/Reset so the SyncLimit path actually catches up. On
+        any failure the node just drops back to Babbling (the next
+        over-limit pull re-enters CatchingUp)."""
+        from ..hashgraph.event import event_from_json_obj
+        from ..hashgraph.root import Root
+
+        with self.selector_lock:
+            peer = self.peer_selector.next()
+        if peer is not None:
+            try:
+                resp = self.trans.fast_forward(
+                    peer.net_addr, FastForwardRequest(self.id))
+                roots = {pk: Root.from_dict(d)
+                         for pk, d in resp.roots.items()}
+                events = [event_from_json_obj(o) for o in resp.events]
+                with self.core_lock:
+                    self.core.fast_forward(roots, events)
+                with self._stats_lock:
+                    self.fast_forwards += 1
+                self.logger.info(
+                    "fast-forward from %s: %d frame events",
+                    peer.net_addr, len(events))
+            except Exception as exc:  # noqa: BLE001
+                self.logger.error(
+                    "fast-forward from %s failed: %s", peer.net_addr, exc)
         self.state.set_state(NodeState.BABBLING)
 
     # -- RPC serving -------------------------------------------------------
@@ -373,6 +402,8 @@ class Node:
             self._process_sync_request(rpc, cmd)
         elif isinstance(cmd, EagerSyncRequest):
             self._process_eager_sync_request(rpc, cmd)
+        elif isinstance(cmd, FastForwardRequest):
+            self._process_fast_forward_request(rpc, cmd)
         else:
             rpc.respond(None, TransportError("unexpected command"))
 
@@ -415,6 +446,25 @@ class Node:
                 err = exc
         rpc.respond(EagerSyncResponse(self.id, success), err)
 
+    def _process_fast_forward_request(
+            self, rpc: RPC, cmd: FastForwardRequest) -> None:
+        import json as _json
+
+        resp: Optional[FastForwardResponse] = None
+        err: Optional[Exception] = None
+        try:
+            with self.core_lock:
+                frame = self.core.get_frame()
+            resp = FastForwardResponse(
+                self.id,
+                roots={pk: r.to_dict() for pk, r in frame.roots.items()},
+                events=[_json.loads(e.marshal()) for e in frame.events],
+            )
+        except Exception as exc:  # noqa: BLE001
+            err = exc
+            resp = FastForwardResponse(self.id)
+        rpc.respond(resp, err)
+
     # -- app side ----------------------------------------------------------
 
     def _commit(self, block: Block) -> None:
@@ -452,6 +502,7 @@ class Node:
             "transaction_pool": str(len(self.core.transaction_pool)),
             "num_peers": str(len(self.peer_selector.peers())),
             "sync_rate": f"{self.sync_rate():.2f}",
+            "fast_forwards": str(self.fast_forwards),
             "events_per_second": f"{events_per_second:.2f}",
             "rounds_per_second": f"{rounds_per_second:.2f}",
             "round_events": str(self.core.get_last_commited_round_events_count()),

@@ -1,0 +1,208 @@
+"""Fast-sync: a node hitting SyncLimit catches up from a peer's Frame
+instead of re-gossiping history.
+
+The reference leaves fastForward as a stub (node/node.go:432-441) but
+ships the machinery it intended to use — GetFrame/Reset
+(hashgraph.go:879-1002). These tests cover the completed flow: the
+Core-level reset+replay through the serialized frame payload, and the
+full node path (SyncLimit -> CatchingUp -> FastForwardRequest ->
+reset+replay -> gossip resumes with consensus parity)."""
+
+import json
+import random
+import time
+
+from babble_tpu import crypto
+from babble_tpu.hashgraph.event import event_from_json_obj
+from babble_tpu.hashgraph.inmem_store import InmemStore
+from babble_tpu.hashgraph.root import Root
+from babble_tpu.net.transport import FastForwardResponse
+from babble_tpu.node.core import Core
+
+from test_node import make_nodes
+
+
+def make_cores(n, engine="host"):
+    keys = [crypto.key_from_seed(7000 + i) for i in range(n)]
+    pubs = ["0x" + crypto.pub_key_bytes(k).hex().upper() for k in keys]
+    order = sorted(range(n), key=lambda i: pubs[i])
+    keys = [keys[i] for i in order]
+    pubs = [pubs[i] for i in order]
+    participants = {pk: i for i, pk in enumerate(pubs)}
+    cores = [
+        Core(i, keys[i], participants, InmemStore(participants, 100000),
+             engine=engine)
+        for i in range(n)
+    ]
+    return cores, participants
+
+
+def gossip_round(cores, a, b):
+    known = cores[a].known()
+    diff = cores[b].diff(known)
+    cores[a].sync(cores[b].to_wire(diff))
+
+
+def test_core_fast_forward_through_wire_frame():
+    """Core.fast_forward over a frame serialized exactly as the
+    transport ships it (Root dicts + full Go-JSON events): the fresh
+    core's view matches the donor's frame, and continued gossip
+    reaches byte-identical consensus order."""
+    cores, participants = make_cores(4)
+    for c in cores[:3]:
+        c.init()
+    rng = random.Random(11)
+    for step in range(200):
+        a, b = rng.sample(range(3), 2)
+        gossip_round(cores, a, b)
+        if step % 5 == 0:
+            cores[a].run_consensus()
+    for c in cores[:3]:
+        c.run_consensus()
+    donor = cores[0]
+    assert donor.get_last_consensus_round_index() >= 1
+
+    r0 = donor.get_last_consensus_round_index()
+    frame = donor.get_frame()
+    # Round-trip through the wire representation.
+    resp = FastForwardResponse(
+        0,
+        roots={pk: r.to_dict() for pk, r in frame.roots.items()},
+        events=[json.loads(e.marshal()) for e in frame.events],
+    )
+    wire = FastForwardResponse.from_dict(resp.to_dict())
+    roots = {pk: Root.from_dict(d) for pk, d in wire.roots.items()}
+    events = [event_from_json_obj(o) for o in wire.events]
+
+    joiner = cores[3]
+    joiner.init()  # its own initial event is wiped by the reset, as in a node
+    joiner.fast_forward(roots, events)
+    want = donor.known()
+    got = joiner.known()
+    for pid, ct in got.items():
+        if pid == 3:  # the joiner's own wiped chain
+            continue
+        assert ct <= want[pid], "joiner knows more than the donor"
+        assert ct >= 0, "joiner learned nothing from the frame"
+
+    # Continued gossip: joiner pulls from the donor, then both decide.
+    for step in range(200):
+        a, b = rng.sample(range(4), 2)
+        # the joiner's reset store can only serve peers after they know
+        # about its post-frame events; keep the flow donor-driven
+        gossip_round(cores, a, b)
+        if step % 5 == 0:
+            cores[a].run_consensus()
+    for c in cores:
+        c.run_consensus()
+    jc = joiner.get_consensus_events()
+    dc = donor.get_consensus_events()
+    assert jc, "joiner reached no consensus after fast-forward"
+    # Within ~2 rounds of the frame base, within-round order can
+    # legitimately differ: consensus timestamps are medians over
+    # oldest-self-ancestor-to-see chains that the frame truncated.
+    # Past that boundary every input to the order is in both DAGs, so
+    # the order must match exactly.
+    def past_boundary(core, hexes):
+        out = []
+        for h in hexes:
+            ev = core.get_event(h)
+            if ev.round_received is not None and ev.round_received > r0 + 2:
+                out.append(h)
+        return out
+
+    jc_f = past_boundary(joiner, jc)
+    dc_f = past_boundary(donor, dc)
+    assert jc_f, "no post-boundary consensus to compare"
+    m = min(len(jc_f), len(dc_f))
+    assert jc_f[:m] == dc_f[:m]
+
+
+def test_node_fast_sync_catches_up():
+    """Full node path over the inmem transport: a late-starting node
+    whose first pull trips SyncLimit enters CatchingUp, fast-forwards
+    from a peer's Frame, and then gossips normally — its consensus
+    order is a contiguous slice of the cluster's."""
+    nodes = make_nodes(4, "inmem")
+    for nd in nodes:
+        nd.conf.sync_limit = 80
+    late = nodes[3]
+    running = nodes[:3]
+    # While the late node is down, keep it out of the running nodes'
+    # peer selectors: its unconsumed inmem queue would turn a third of
+    # all pulls into 2s timeouts.
+    from babble_tpu.node.peer_selector import RandomPeerSelector
+    full_peers = {id(nd): nd.peer_selector.peers() for nd in running}
+    for nd in running:
+        alive = [p for p in nd.peer_selector.peers()
+                 if p.net_addr != late.local_addr]
+        nd.peer_selector = RandomPeerSelector(alive, nd.local_addr)
+    import threading
+    stop = threading.Event()
+
+    def bombard():
+        # Nodes go quiescent by design when nothing is pending —
+        # continuous submission keeps the DAG growing (the reference's
+        # bombardAndWait, node_test.go:507-545).
+        i = 0
+        while not stop.is_set():
+            try:
+                running[i % len(running)].submit_tx(
+                    f"fastsync tx {i}".encode())
+            except Exception:
+                pass
+            i += 1
+            time.sleep(0.005)
+
+    try:
+        for nd in running:
+            nd.run_async(gossip=True)
+        threading.Thread(target=bombard, daemon=True).start()
+        deadline = time.monotonic() + 120.0
+        committed = lambda: min(  # noqa: E731
+            len(nd.core.get_consensus_events()) for nd in running)
+        while time.monotonic() < deadline and committed() < 300:
+            time.sleep(0.25)
+        assert committed() >= 300, "cluster did not advance enough"
+
+        # Bring the late node up and restore full selectors.
+        for nd in running:
+            nd.peer_selector = RandomPeerSelector(
+                full_peers[id(nd)], nd.local_addr)
+        late.run_async(gossip=True)
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline and not (
+            late.fast_forwards >= 1
+            and len(late.core.get_consensus_events()) > 0
+        ):
+            time.sleep(0.25)
+        assert late.fast_forwards >= 1, "late node never fast-forwarded"
+        lc = late.core.get_consensus_events()
+        assert lc, "late node reached no consensus after fast-forward"
+        ref = nodes[0].core.get_consensus_events()
+        # wait until node0 has at least caught the start of late's list
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and lc[0] not in ref:
+            time.sleep(0.25)
+            ref = nodes[0].core.get_consensus_events()
+        # Skip the frame-boundary region (see the core-level test):
+        # compare from the first event BOTH lists contain, two rounds
+        # past the late node's first received round.
+        lrr = [late.core.get_event(h).round_received for h in lc]
+        base = min(r for r in lrr if r is not None)
+        lc_f = [h for h, r in zip(lc, lrr) if r is not None and r > base + 2]
+        ref_set = set(ref)
+        lc_f = [h for h in lc_f if h in ref_set]
+        assert lc_f, "no comparable post-boundary consensus"
+        start = ref.index(lc_f[0])
+        # ref may contain boundary events the late node ordered
+        # differently; compare the subsequence of ref restricted to
+        # the late node's post-boundary events
+        ref_r = [h for h in ref[start:] if h in set(lc_f)]
+        m = min(len(lc_f), len(ref_r))
+        assert m > 0
+        assert lc_f[:m] == ref_r[:m]
+    finally:
+        stop.set()
+        for nd in nodes:
+            nd.shutdown()
