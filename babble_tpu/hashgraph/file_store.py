@@ -198,15 +198,25 @@ class FileStore:
 
     def participant_events(self, participant: str, skip: int) -> List[str]:
         try:
-            return self.inmem.participant_events(participant, skip)
+            res = self.inmem.participant_events(participant, skip)
+            # A freshly loaded store's rolling window is empty and
+            # returns [] without error; distinguish "synced empty"
+            # (participant known in the window) from "window knows
+            # nothing" (is_root) and serve the latter from the db.
+            if res:
+                return res
+            _, is_root = self.inmem.last_from(participant)
+            if not is_root:
+                return res
         except StoreError:
-            with self._lock:
-                rows = self._db.execute(
-                    "SELECT hex FROM events WHERE creator = ? AND idx > ? "
-                    "ORDER BY idx",
-                    (participant, skip),
-                ).fetchall()
-            return [r[0] for r in rows]
+            pass
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT hex FROM events WHERE creator = ? AND idx > ? "
+                "ORDER BY idx",
+                (participant, skip),
+            ).fetchall()
+        return [r[0] for r in rows]
 
     def participant_event(self, participant: str, index: int) -> str:
         try:

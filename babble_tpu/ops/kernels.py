@@ -69,6 +69,15 @@ def compute_last_ancestors(self_parent, other_parent, creator, index, levels, *,
     return la[:e]
 
 
+def chunk_width(w: int, row_elems: int, budget: int = 1 << 26) -> int:
+    """Width of a processing chunk such that chunk*row_elems stays
+    under `budget` elements. Callers iterate ceil(w/wc) chunks with
+    CLAMPED dynamic slices (the final chunk re-reads/rewrites a few
+    overlapping rows, which is idempotent) — no divisibility demanded,
+    so a prime width cannot collapse the chunk to 1."""
+    return max(min(budget // max(row_elems, 1), w), 1)
+
+
 @functools.partial(jax.jit, static_argnames=("n",))
 def first_descendant_cube(la, chain, chain_len, *, n):
     """pos2k[c, i, t] = first position k on creator c's chain whose
@@ -197,12 +206,28 @@ def compute_rounds(
         pr = jnp.where(use_op, op_round, sp_round)
         pr_root = jnp.where(use_op, op < 0, sp < 0)
         # roundInc: count parent-round witnesses strongly seen.
+        # Chunked over the level width: the [W, n, n] candidate-fd
+        # gather is the kernel's peak transient, and a full-width
+        # level at n=4096 would materialize n^3 ints.
         cand = wt[jnp.clip(pr, 0, r - 1)]  # [W, n]
         cand_valid = cand >= 0
-        fd_c = fd[jnp.where(cand_valid, cand, 0)]  # [W, n, n]
         la_x = la_p[sids]  # [W, n]
-        ss = ((la_x[:, None, :] >= fd_c).sum(-1) >= sm) & cand_valid
-        inc = pr_root | (ss.sum(-1) >= sm)
+        w = la_x.shape[0]
+        wc = chunk_width(w, n * n)
+
+        def ss_chunk(g, cnt):
+            w0 = g * wc  # clamped by dynamic_slice on the final chunk
+            la_g = lax.dynamic_slice(la_x, (w0, 0), (wc, n))
+            cand_g = lax.dynamic_slice(cand, (w0, 0), (wc, n))
+            cv_g = cand_g >= 0
+            fd_g = fd[jnp.where(cv_g, cand_g, 0)]  # [wc, n, n]
+            ss_g = ((la_g[:, None, :] >= fd_g).sum(-1) >= sm) & cv_g
+            return lax.dynamic_update_slice(
+                cnt, ss_g.sum(-1, dtype=jnp.int32), (w0,))
+
+        ss_cnt = lax.fori_loop(0, -(-w // wc), ss_chunk,
+                               jnp.zeros((w,), jnp.int32))
+        inc = pr_root | (ss_cnt >= sm)
         r_new = pr + inc.astype(jnp.int32)
         # witness: sits on the Root, or exceeds the self-parent's round
         # (hashgraph.go:265-282).

@@ -60,7 +60,8 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .kernels import FAME_TRUE, FAME_FALSE, FAME_UNDEFINED, INT32_MAX, ZERO_TS_RANK
+from .kernels import (FAME_TRUE, FAME_FALSE, FAME_UNDEFINED, INT32_MAX,
+                      ZERO_TS_RANK, chunk_width)
 
 
 def _axis_size(mesh: Mesh, axis: MeshAxis) -> int:
@@ -271,14 +272,29 @@ def make_rounds(mesh: Mesh, *, n: int, sm: int, r: int, axis: MeshAxis = "sp"):
             # Candidate strongly-see tally, sharded over the candidate
             # chains: device p compares against fd rows of ITS creators'
             # candidate witnesses and the counts psum to the full tally.
+            # Chunked over the level width: the [W, n/d, n] gather is
+            # the pipeline's peak transient, and at n=4096 a full-width
+            # level would materialize n^3/d ints per device.
             pr_c = jnp.clip(pr, 0, r - 1)
             cand = wt[pr_c]  # [W, n] replicated table
             cand_valid = cand >= 0
-            fd_c_loc = fd_wt[pr_c]  # [W, n/d, n] local witness fd rows
-            ss_loc = (la_x[:, None, :] >= fd_c_loc).sum(-1) >= sm
-            # Mask to valid candidates in this shard's columns.
-            ss_loc = ss_loc & _slice_cols(cand_valid, off, n_loc)
-            cnt = lax.psum(ss_loc.sum(-1, dtype=jnp.int32), axis)  # [W]
+            cv_loc = _slice_cols(cand_valid, off, n_loc)  # [W, n/d]
+            wc = chunk_width(w, n_loc * n)
+
+            def tally_chunk(g, cnt_loc):
+                w0 = g * wc  # clamped on the final chunk (idempotent)
+                la_g = lax.dynamic_slice(la_x, (w0, 0), (wc, n))
+                prc_g = lax.dynamic_slice(pr_c, (w0,), (wc,))
+                cv_g = lax.dynamic_slice(cv_loc, (w0, 0), (wc, n_loc))
+                fd_g = fd_wt[prc_g]  # [wc, n/d, n]
+                ss_g = (la_g[:, None, :] >= fd_g).sum(-1) >= sm
+                ss_g = ss_g & cv_g
+                return lax.dynamic_update_slice(
+                    cnt_loc, ss_g.sum(-1, dtype=jnp.int32), (w0,))
+
+            cnt_loc = lax.fori_loop(
+                0, -(-w // wc), tally_chunk, jnp.zeros((w,), jnp.int32))
+            cnt = lax.psum(cnt_loc, axis)  # [W]
 
             inc = pr_root | (cnt >= sm)
             r_new = pr + inc.astype(jnp.int32)

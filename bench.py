@@ -614,7 +614,7 @@ def child():
         if _budget_left() > 450 and not on_cpu:
             try:
                 node_eps = node_testnet_events_per_sec(
-                    engine="tpu", warm_s=330.0, window_s=75.0)
+                    engine="tpu", warm_s=210.0, window_s=75.0)
                 log(f"  4-node --engine tpu testnet (one shared chip): "
                     f"{node_eps:,.1f} committed events/s")
                 payload["node_tpu_events_per_s"] = round(node_eps, 1)
@@ -733,6 +733,48 @@ def child():
                     f"{extrapolated:,.0f}s vs device {best:.1f}s "
                     f"({extrapolated / best:,.0f}x)")
                 _emit(payload)
+
+            # vs-Go calibration (BASELINE.json's target names Go, not
+            # Python): build and run the C++ conservative stand-in for
+            # the reference engine's data path (cpp/ref_model_bench.cc
+            # — flat int-indexed storage, no GC, no signatures, fame
+            # and FindOrder omitted; every choice makes it FASTER than
+            # real Go), and report the resulting LOWER bound on the
+            # device-vs-Go wall-clock multiple. The Python-host
+            # extrapolation above brackets it from the other side.
+            if _budget_left() > 120:
+                try:
+                    src = os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "cpp", "ref_model_bench.cc")
+                    binp = os.path.join(CACHE_DIR, "ref_model_bench")
+                    stale = (not os.path.exists(binp)
+                             or os.path.getmtime(binp)
+                             < os.path.getmtime(src))
+                    if stale:
+                        subprocess.run(
+                            ["g++", "-O3", "-march=native", "-o", binp,
+                             src], check=True, timeout=120)
+                    out = subprocess.run(
+                        [binp, str(n), str(e)], capture_output=True,
+                        timeout=1200, check=True)
+                    model = json.loads(out.stdout)
+                    model_wall = float(model["wall_s"])
+                    vs_go_min = model_wall / best
+                    payload["vs_go_model_wall_s"] = round(model_wall, 2)
+                    payload["vs_go_estimated_min"] = round(vs_go_min, 1)
+                    payload["vs_go_basis"] = (
+                        "lower bound: wall of a C++ reimplementation "
+                        "of the reference insert+DivideRounds data "
+                        "path (cpp/ref_model_bench.cc), strictly "
+                        "faster than Go (no GC/strings/signatures, "
+                        "fame+order omitted), vs the device one-shot")
+                    log(f"  vs-Go: C++ model {model_wall:,.1f}s vs "
+                        f"device {best:.1f}s -> >= {vs_go_min:,.0f}x "
+                        f"(conservative lower bound)")
+                    _emit(payload)
+                except Exception as exc:  # noqa: BLE001
+                    log(f"  vs-Go calibration failed: {exc}")
         except Exception as exc:  # noqa: BLE001
             log(f"  northstar failed: {exc}")
 

@@ -1,0 +1,140 @@
+// Conservative native stand-in for the reference Go engine's wall-clock
+// at the north-star size (n=1024 peers, e=100k events): a C++
+// reimplementation of the reference's insert + DivideRounds data path
+// (hashgraph.go:448-530 InitEventCoordinates /
+// UpdateAncestorFirstDescendant; :285-339 Round/RoundInc; :170-200
+// StronglySee), driven by the same synthetic uniform-gossip schedule
+// the Python/TPU north-star benchmark uses.
+//
+// Every modeling choice is conservative — i.e. makes THIS model faster
+// than real Go, so the TPU-vs-Go multiplier derived from it is a lower
+// bound:
+//   - events live in a flat vector indexed by int id; the reference
+//     keys an LRU cache by hex strings (map + string hashing + GC).
+//   - rounds are computed once per event in topological order; the
+//     reference rescans its undetermined list every sync (cache hits,
+//     but still loop + map traffic).
+//   - DecideFame and FindOrder are OMITTED entirely (the reference
+//     must run both to reach consensus order).
+//   - no signature verification (the Go node verifies per insert).
+//
+// Build: g++ -O3 -march=native -o ref_model_bench ref_model_bench.cc
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <random>
+#include <vector>
+
+static constexpr int32_t INT32_MAX_ = 2147483647;
+
+int main(int argc, char** argv) {
+  const int n = argc > 1 ? atoi(argv[1]) : 1024;
+  const int e_tot = argc > 2 ? atoi(argv[2]) : 100000;
+  const int sm = 2 * n / 3 + 1;
+
+  // Synthetic uniform gossip schedule (ops/dag.py synthetic_dag's
+  // process: each event's creator is random; other-parent is a random
+  // other peer's current head).
+  std::mt19937 rng(1);
+  std::uniform_int_distribution<int> pick(0, n - 1);
+
+  struct Ev {
+    int32_t creator, index, self_parent, other_parent, round;
+    bool witness;
+    std::vector<int32_t> la, fd;  // lastAncestors / firstDescendants
+  };
+  std::vector<Ev> evs(e_tot);
+  std::vector<int32_t> head(n, -1), idx(n, 0);
+  // Per-creator chains give O(1) ancestor resolution by (creator,
+  // index) — cheaper than the reference's hash->event map lookups
+  // (conservative).
+  std::vector<std::vector<int32_t>> chain(n);
+  std::vector<std::vector<int32_t>> round_witnesses;
+  round_witnesses.reserve(1024);
+
+  auto t0 = std::chrono::steady_clock::now();
+  for (int i = 0; i < e_tot; ++i) {
+    int a = pick(rng);
+    int b = pick(rng);
+    while (b == a) b = pick(rng);
+    Ev& ev = evs[i];
+    ev.creator = a;
+    ev.index = idx[a]++;
+    ev.self_parent = head[a];
+    ev.other_parent = head[b];
+    head[a] = i;
+
+    // InitEventCoordinates (hashgraph.go:448-500)
+    ev.fd.assign(n, INT32_MAX_);
+    ev.la.assign(n, -1);
+    const Ev* sp = ev.self_parent >= 0 ? &evs[ev.self_parent] : nullptr;
+    const Ev* op = ev.other_parent >= 0 ? &evs[ev.other_parent] : nullptr;
+    if (sp && op) {
+      for (int k = 0; k < n; ++k)
+        ev.la[k] = sp->la[k] >= op->la[k] ? sp->la[k] : op->la[k];
+    } else if (sp) {
+      ev.la = sp->la;
+    } else if (op) {
+      ev.la = op->la;
+    }
+    ev.fd[a] = ev.index;
+    ev.la[a] = ev.index;
+
+    // UpdateAncestorFirstDescendant (hashgraph.go:502-530): walk each
+    // last-ancestor's self-parent chain until an already-set slot.
+    chain[a].push_back(i);
+    for (int k = 0; k < n; ++k) {
+      int32_t anc_idx = ev.la[k];
+      while (anc_idx >= 0) {
+        Ev& anc = evs[chain[k][anc_idx]];
+        if (anc.fd[a] == INT32_MAX_) {
+          anc.fd[a] = ev.index;
+          anc_idx -= 1;  // self-parent
+        } else {
+          break;
+        }
+      }
+    }
+
+    // Round / RoundInc (hashgraph.go:285-339): parent round, then
+    // strongly-see count over the parent round's witnesses.
+    int32_t parent_round = -1;
+    bool is_root = !sp && !op;
+    if (sp) parent_round = sp->round;
+    if (op && op->round > parent_round) parent_round = op->round;
+    if (is_root) {
+      ev.round = 0;
+    } else {
+      bool inc = false;
+      if (parent_round < 0) {
+        inc = true;
+        ev.round = parent_round + 1;
+      } else {
+        int c = 0;
+        for (int32_t w : round_witnesses[parent_round]) {
+          // stronglySee(ev, w) via coordinates (hashgraph.go:179-200)
+          const Ev& wy = evs[w];
+          int cnt = 0;
+          for (int k = 0; k < n; ++k)
+            if (ev.la[k] >= wy.fd[k]) ++cnt;
+          if (cnt >= sm) ++c;
+        }
+        inc = c >= sm;
+        ev.round = parent_round + (inc ? 1 : 0);
+      }
+    }
+    ev.witness = !sp || ev.round > (sp ? evs[ev.self_parent].round : -1);
+    if (ev.witness) {
+      if ((int)round_witnesses.size() <= ev.round)
+        round_witnesses.resize(ev.round + 1);
+      round_witnesses[ev.round].push_back(i);
+    }
+  }
+  auto t1 = std::chrono::steady_clock::now();
+  double secs = std::chrono::duration<double>(t1 - t0).count();
+  int last_round = (int)round_witnesses.size() - 1;
+  printf("{\"n\": %d, \"events\": %d, \"wall_s\": %.3f, "
+         "\"events_per_s\": %.1f, \"last_round\": %d}\n",
+         n, e_tot, secs, e_tot / secs, last_round);
+  return 0;
+}

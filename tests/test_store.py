@@ -123,3 +123,148 @@ def test_file_store_bootstrap_consensus(tmp_path):
     assert h3.consensus_events() == expected_order
     assert h3.last_consensus_round == expected_last_round
     fs2.close()
+
+
+# ------------------------------------------------------------------
+# FileStore cache-eviction -> db fallback, per method (the reference's
+# badger_store_test.go:66-491 checks this cache-vs-db layering and the
+# error type each method returns).
+
+
+def _evicted_file_store(tmp_path, cache=4, per_creator=12):
+    """A FileStore whose tiny inmem layer has provably evicted the
+    early events: two creators, `per_creator` events each, LRU size
+    `cache` << total."""
+    keys, pubs, participants = make_participants(2)
+    path = os.path.join(tmp_path, "evict.db")
+    fs = FileStore(participants, cache, path)
+    heads = {p: "" for p in pubs}
+    all_events = {p: [] for p in pubs}
+    ts = 1_700_000_000_000_000_000
+    for idx in range(per_creator):
+        for k, p in zip(keys, pubs):
+            ev = signed_event(k, p, [heads[p], ""], idx, ts)
+            ts += 1000
+            ev.topological_index = idx
+            fs.set_event(ev)
+            heads[p] = ev.hex()
+            all_events[p].append(ev)
+    return fs, pubs, all_events
+
+
+def test_file_store_get_event_falls_back_to_db(tmp_path):
+    fs, pubs, evs = _evicted_file_store(tmp_path)
+    early = evs[pubs[0]][0]
+    # provably evicted from the inmem layer...
+    with pytest.raises(StoreError):
+        fs.inmem.get_event(early.hex())
+    # ...but the store still serves it, byte-identically, from sqlite.
+    got = fs.get_event(early.hex())
+    assert got.marshal() == early.marshal()
+    assert got.topological_index == early.topological_index
+    # and a genuinely unknown key is KEY_NOT_FOUND.
+    with pytest.raises(StoreError) as ei:
+        fs.get_event("0xDEAD")
+    assert is_store_err(ei.value, StoreErrType.KEY_NOT_FOUND)
+    fs.close()
+
+
+def test_file_store_has_event_falls_back_to_db(tmp_path):
+    fs, pubs, evs = _evicted_file_store(tmp_path)
+    early = evs[pubs[0]][0]
+    assert not fs.inmem.has_event(early.hex())
+    assert fs.has_event(early.hex())
+    assert not fs.has_event("0xDEAD")
+    fs.close()
+
+
+def test_file_store_participant_events_falls_back_to_db(tmp_path):
+    fs, pubs, evs = _evicted_file_store(tmp_path)
+    p = pubs[0]
+    # the rolling window no longer reaches skip=-1 (TooLate inmem)...
+    with pytest.raises(StoreError) as ei:
+        fs.inmem.participant_events(p, -1)
+    assert is_store_err(ei.value, StoreErrType.TOO_LATE)
+    # ...the db serves the complete history, in index order.
+    full = fs.participant_events(p, -1)
+    assert full == [e.hex() for e in evs[p]]
+    # and a mid-history skip too.
+    assert fs.participant_events(p, 5) == [e.hex() for e in evs[p][6:]]
+    fs.close()
+
+
+def test_file_store_participant_event_falls_back_to_db(tmp_path):
+    fs, pubs, evs = _evicted_file_store(tmp_path)
+    p = pubs[0]
+    with pytest.raises(StoreError):
+        fs.inmem.participant_event(p, 0)
+    assert fs.participant_event(p, 0) == evs[p][0].hex()
+    with pytest.raises(StoreError) as ei:
+        fs.participant_event(p, 999)
+    assert is_store_err(ei.value, StoreErrType.KEY_NOT_FOUND)
+    fs.close()
+
+
+def test_file_store_rounds_fall_back_to_db(tmp_path):
+    from babble_tpu.hashgraph.round_info import RoundInfo
+
+    keys, pubs, participants = make_participants(2)
+    fs = FileStore(participants, 4, os.path.join(tmp_path, "r.db"))
+    for r in range(10):
+        ri = RoundInfo()
+        ri.add_event(f"0xE{r:02d}", r % 2 == 0)
+        fs.set_round(r, ri)
+    # round 0 evicted from the LRU...
+    with pytest.raises(StoreError):
+        fs.inmem.get_round(0)
+    got = fs.get_round(0)
+    assert "0xE00" in got.events and got.events["0xE00"].witness
+    # witnesses/events helpers ride the same fallback
+    assert fs.round_witnesses(0) == ["0xE00"]
+    assert fs.round_events(0) == 1
+    assert fs.last_round() == 9
+    with pytest.raises(StoreError) as ei:
+        fs.get_round(77)
+    assert is_store_err(ei.value, StoreErrType.KEY_NOT_FOUND)
+    fs.close()
+
+
+def test_file_store_roots_and_errors(tmp_path):
+    keys, pubs, participants = make_participants(2)
+    fs = FileStore(participants, 4, os.path.join(tmp_path, "roots.db"))
+    root = fs.get_root(pubs[0])
+    assert root.index == -1 and root.round == -1
+    with pytest.raises(StoreError) as ei:
+        fs.get_root("0xNOBODY")
+    assert is_store_err(ei.value, StoreErrType.NO_ROOT)
+    fs.close()
+
+
+def test_file_store_blocks_fall_back_to_db(tmp_path):
+    from babble_tpu.hashgraph.block import Block
+
+    keys, pubs, participants = make_participants(2)
+    fs = FileStore(participants, 4, os.path.join(tmp_path, "b.db"))
+    for rr in range(10):
+        fs.set_block(Block(rr, [f"tx{rr}".encode()]))
+    with pytest.raises(StoreError):
+        fs.inmem.get_block(0)
+    got = fs.get_block(0)
+    assert got.round_received == 0 and got.transactions == [b"tx0"]
+    with pytest.raises(StoreError) as ei:
+        fs.get_block(99)
+    assert is_store_err(ei.value, StoreErrType.KEY_NOT_FOUND)
+    fs.close()
+
+
+def test_file_store_reload_serves_evicted_history(tmp_path):
+    """Close + FileStore.load: the reloaded store's db layer still has
+    everything, including what the pre-close LRU had evicted."""
+    fs, pubs, evs = _evicted_file_store(tmp_path)
+    fs.close()
+    fs2 = FileStore.load(4, os.path.join(tmp_path, "evict.db"))
+    early = evs[pubs[0]][0]
+    assert fs2.get_event(early.hex()).marshal() == early.marshal()
+    assert fs2.participant_events(pubs[0], -1) == [
+        e.hex() for e in evs[pubs[0]]]
+    fs2.close()

@@ -1,0 +1,255 @@
+"""Transport unit suite — the reference's generic transport tests plus
+TCP-specific pooling/framing tests (net/transport_test.go:28-164,
+net/net_transport_test.go:13-245), ported to the inmem and TCP
+transports behind the same Transport protocol."""
+
+import queue
+import socket
+import threading
+import time
+
+import pytest
+
+from babble_tpu.gojson import Timestamp
+from babble_tpu.hashgraph.event import WireBody, WireEvent
+from babble_tpu.net import InmemTransport, TCPTransport
+from babble_tpu.net.inmem_transport import connect_all
+from babble_tpu.net.transport import (
+    EagerSyncRequest,
+    EagerSyncResponse,
+    FastForwardRequest,
+    FastForwardResponse,
+    SyncRequest,
+    SyncResponse,
+    TransportError,
+)
+
+
+def make_pair(kind, **kw):
+    if kind == "inmem":
+        t1 = InmemTransport("addrA", timeout=1.0)
+        t2 = InmemTransport("addrB", timeout=1.0)
+        connect_all([t1, t2])
+    else:
+        t1 = TCPTransport("127.0.0.1:0", timeout=1.0, **kw)
+        t2 = TCPTransport("127.0.0.1:0", timeout=1.0, **kw)
+    return t1, t2
+
+
+def wire_event():
+    return WireEvent(
+        WireBody(
+            transactions=None,
+            self_parent_index=1,
+            other_parent_creator_id=10,
+            other_parent_index=0,
+            creator_id=9,
+            timestamp=Timestamp(1_700_000_000_000_000_123),
+            index=1,
+        ),
+        r=12345,
+        s=67890,
+    )
+
+
+def serve(trans, expect_type, resp, n=1, fail=None):
+    """Answer n inbound RPCs with `resp` (reference's listener goroutine)."""
+
+    def loop():
+        for _ in range(n):
+            try:
+                rpc = trans.consumer().get(timeout=5.0)
+            except queue.Empty:
+                return
+            assert isinstance(rpc.command, expect_type)
+            rpc.respond(resp, fail)
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return t
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_start_stop(kind):
+    t1, t2 = make_pair(kind)
+    t1.close()
+    t2.close()
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_sync_round_trip(kind):
+    """TestTransport_Sync / TestNetworkTransport_Sync: request fields
+    and the full response (sync_limit, events, known) survive the
+    round trip byte-for-byte."""
+    t1, t2 = make_pair(kind)
+    try:
+        args = SyncRequest(from_id=0, known={0: 1, 1: 2, 2: 3})
+        resp = SyncResponse(
+            from_id=1,
+            events=[wire_event()],
+            known={0: 4, 1: 5, 2: 6},
+        )
+
+        got_cmd = {}
+
+        def loop():
+            rpc = t1.consumer().get(timeout=5.0)
+            got_cmd["known"] = dict(rpc.command.known)
+            got_cmd["from_id"] = rpc.command.from_id
+            rpc.respond(resp, None)
+
+        threading.Thread(target=loop, daemon=True).start()
+        out = t2.sync(t1.local_addr(), args)
+        assert got_cmd == {"known": {0: 1, 1: 2, 2: 3}, "from_id": 0}
+        assert out.from_id == 1
+        assert out.sync_limit is False
+        assert out.known == {0: 4, 1: 5, 2: 6}
+        assert len(out.events) == 1
+        we = out.events[0]
+        assert we.body.self_parent_index == 1
+        assert we.body.other_parent_creator_id == 10
+        assert we.body.creator_id == 9
+        assert int(we.r) == 12345 and int(we.s) == 67890
+    finally:
+        t1.close()
+        t2.close()
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_eager_sync_round_trip(kind):
+    t1, t2 = make_pair(kind)
+    try:
+        serve(t1, EagerSyncRequest, EagerSyncResponse(1, True))
+        out = t2.eager_sync(
+            t1.local_addr(), EagerSyncRequest(0, [wire_event()]))
+        assert out.from_id == 1 and out.success is True
+    finally:
+        t1.close()
+        t2.close()
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_fast_forward_round_trip(kind):
+    t1, t2 = make_pair(kind)
+    try:
+        resp = FastForwardResponse(
+            1,
+            roots={"0xAB": {"X": "h1", "Y": "h2", "Index": 3, "Round": 2,
+                            "Others": {}}},
+            events=[{"Body": {"Index": 0}}],
+        )
+        serve(t1, FastForwardRequest, resp)
+        out = t2.fast_forward(t1.local_addr(), FastForwardRequest(0))
+        assert out.from_id == 1
+        assert out.roots["0xAB"]["Index"] == 3
+        assert out.events == [{"Body": {"Index": 0}}]
+    finally:
+        t1.close()
+        t2.close()
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_error_response_propagates(kind):
+    """A handler error comes back as a TransportError at the caller
+    (the TCP framing carries it as the error-string line)."""
+    t1, t2 = make_pair(kind)
+    try:
+        serve(t1, SyncRequest, SyncResponse(1), fail=TransportError("busy"))
+        with pytest.raises(TransportError):
+            t2.sync(t1.local_addr(), SyncRequest(0, {}))
+    finally:
+        t1.close()
+        t2.close()
+
+
+def test_inmem_unknown_peer():
+    t1 = InmemTransport("addrA", timeout=0.3)
+    with pytest.raises(TransportError):
+        t1.sync("nowhere", SyncRequest(0, {}))
+    t1.close()
+
+
+def test_inmem_timeout_on_nonconsuming_peer():
+    """A wedged peer (nobody draining the consumer) must surface as a
+    timeout, not a hang."""
+    t1, t2 = make_pair("inmem")
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TransportError):
+            t2.sync(t1.local_addr(), SyncRequest(0, {}))
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        t1.close()
+        t2.close()
+
+
+def test_tcp_pooled_conn_reuse():
+    """TestNetworkTransport_PooledConn: back-to-back and concurrent
+    RPCs reuse pooled connections, and the pool never exceeds
+    max_pool."""
+    t1, t2 = make_pair("tcp", max_pool=2)
+    try:
+        resp = SyncResponse(1, events=[wire_event()])
+        serve(t1, SyncRequest, resp, n=40)
+        args = SyncRequest(0, {0: 1})
+
+        errs = []
+
+        def worker():
+            try:
+                for _ in range(5):
+                    out = t2.sync(t1.local_addr(), args)
+                    assert out.from_id == 1
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20.0)
+        assert not errs, errs
+        with t2._pool_lock:
+            pooled = sum(len(v) for v in t2._pool.values())
+        assert 1 <= pooled <= 2, f"pool size {pooled} vs max_pool 2"
+    finally:
+        t1.close()
+        t2.close()
+
+
+def test_tcp_garbage_frame_does_not_kill_listener():
+    """A connection that sends a bogus tag + junk must not take the
+    transport down; real RPCs still work afterwards."""
+    t1, t2 = make_pair("tcp")
+    try:
+        host, port = t1.local_addr().rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=1.0)
+        s.sendall(b"\xff this is not a frame\n")
+        time.sleep(0.2)
+        s.close()
+
+        serve(t1, SyncRequest, SyncResponse(1))
+        out = t2.sync(t1.local_addr(), SyncRequest(0, {}))
+        assert out.from_id == 1
+    finally:
+        t1.close()
+        t2.close()
+
+
+def test_tcp_sync_after_peer_restart():
+    """Pooled connections to a dead listener are detected and replaced:
+    after the peer closes, a call errors; the pool does not serve
+    stale sockets forever."""
+    t1, t2 = make_pair("tcp")
+    addr = t1.local_addr()
+    try:
+        serve(t1, SyncRequest, SyncResponse(1))
+        out = t2.sync(addr, SyncRequest(0, {}))
+        assert out.from_id == 1
+        t1.close()
+        time.sleep(0.1)
+        with pytest.raises(TransportError):
+            t2.sync(addr, SyncRequest(0, {}))
+    finally:
+        t1.close()
+        t2.close()
