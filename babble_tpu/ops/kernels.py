@@ -78,6 +78,33 @@ def chunk_width(w: int, row_elems: int, budget: int = 1 << 26) -> int:
     return max(min(budget // max(row_elems, 1), w), 1)
 
 
+def _bcast_budget() -> int:
+    """Chunk budget for broadcast-COMPARE intermediates: the CPU
+    backend materializes them (tight budget), the TPU streams the
+    fused compare+reduce (loose budget, fewer sequential steps). Only
+    for broadcasts — gather-bounded chunks (which materialize on every
+    backend) keep the default tight budget."""
+    return (1 << 26) if jax.default_backend() == "cpu" else (1 << 28)
+
+
+def strongly_see_counts_chunked(la_rows, fd_p, *, n):
+    """ss_cnt[y, x] = #{k : la_rows[y, k] >= fd_p[x, k]} — the pairwise
+    strongly-see tally, chunked over the voter axis so the [Y, n, n]
+    broadcast stays bounded where the backend materializes it."""
+    y_n = la_rows.shape[0]
+    yc = chunk_width(y_n, n * n, _bcast_budget())
+
+    def ss_yc(g, acc):
+        y0 = g * yc  # clamped on the final chunk (idempotent)
+        la_g = lax.dynamic_slice(la_rows, (y0, 0), (yc, n))
+        cnt_g = (la_g[:, None, :] >= fd_p[None, :, :]).sum(
+            -1, dtype=jnp.int32)
+        return lax.dynamic_update_slice(acc, cnt_g, (y0, 0))
+
+    return lax.fori_loop(
+        0, -(-y_n // yc), ss_yc, jnp.zeros((y_n, n), jnp.int32))
+
+
 @functools.partial(jax.jit, static_argnames=("n",))
 def first_descendant_cube(la, chain, chain_len, *, n):
     """pos2k[c, i, t] = first position k on creator c's chain whose
@@ -288,7 +315,9 @@ def decide_fame_impl(wt, la, fd, index, coin, *, n, sm, r):
         if pallas_ss:
             ss_cnt = strongly_see_counts_auto(la_y, fd_p)
         else:
-            ss_cnt = (la_y[:, None, :] >= fd_p[None, :, :]).sum(-1)
+            # The [n, n, n] pairwise compare is the per-round hot op;
+            # chunked where the backend materializes the broadcast.
+            ss_cnt = strongly_see_counts_chunked(la_y, fd_p, n=n)
         ss = (ss_cnt >= sm) & wp_valid[None, :]
         # f32 contraction rides the MXU; tallies are <= n < 2^24 so
         # float32 arithmetic is exact.

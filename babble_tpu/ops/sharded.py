@@ -61,7 +61,8 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .kernels import (FAME_TRUE, FAME_FALSE, FAME_UNDEFINED, INT32_MAX,
-                      ZERO_TS_RANK, chunk_width)
+                      ZERO_TS_RANK, chunk_width,
+                      strongly_see_counts_chunked)
 
 
 def _axis_size(mesh: Mesh, axis: MeshAxis) -> int:
@@ -397,8 +398,8 @@ def make_fame(mesh: Mesh, *, n: int, sm: int, r: int, axis: MeshAxis = "sp"):
             see_v = la_y[:, None, :] >= idx_x[None, :, :]
             wp_valid = wt[j - 1] >= 0
             fd_p = fd_wt[j - 1]  # [n, n]
-            ss = ((la_y[:, None, :] >= fd_p[None, :, :]).sum(-1) >= sm)
-            ss = ss & wp_valid[None, :]
+            ss_cnt = strongly_see_counts_chunked(la_y, fd_p, n=n)
+            ss = (ss_cnt >= sm) & wp_valid[None, :]
             # Round j-1's votes by ALL voters feed the tally.
             v_prev = lax.all_gather(v_loc, axis, axis=0, tiled=True)
             yays = (
