@@ -101,7 +101,7 @@ def cmd_run(args) -> int:
         consensus_interval=(
             args.consensus_interval / 1000.0
             if args.consensus_interval is not None
-            else (1.0 if args.engine == "tpu" else 0.0)),
+            else (0.25 if args.engine == "tpu" else 0.0)),
         logger=logger,
     )
 
@@ -194,9 +194,9 @@ def build_parser() -> argparse.ArgumentParser:
     rn.add_argument("--consensus_interval", type=int, default=None,
                     help="min milliseconds between consensus passes "
                          "(0 = after every sync, the reference cadence; "
-                         "default 0 for --engine host, 1000 for tpu so "
-                         "many syncs share one device pass — each "
-                         "pass costs a device round trip)")
+                         "default 0 for --engine host, 250 for tpu — "
+                         "the FLOOR of an adaptive cadence that tracks "
+                         "~3x the measured device-pass wall)")
     rn.set_defaults(fn=cmd_run)
 
     vs = sub.add_parser("version", help="print version")

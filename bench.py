@@ -306,7 +306,8 @@ def host_engine_events_per_sec(n_peers, n_events, seed=7):
 
 
 def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
-                                window_s=30.0, interval=None):
+                                window_s=30.0, interval=None,
+                                warm_gate_events=1500):
     """Throughput of a live localhost testnet: N real nodes (threads,
     inmem transport, signed events, full sync protocol) bombarded with
     transactions; returns committed consensus events/sec during a
@@ -358,7 +359,10 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
         # undecided-round rescan (A/B 52 vs 78 ev/s); the 4-node host
         # testnet keeps the reference's per-sync cadence.
         if interval is None:
-            interval = 1.0 if engine == "tpu" else 0.0
+            # tpu: the FLOOR of the adaptive cadence (the worker
+            # tracks ~3x its measured pass wall, see node.py
+            # _consensus_loop).
+            interval = 0.25 if engine == "tpu" else 0.0
         conf.consensus_interval = interval
         node = Node(conf, i, key, peers, InmemStore(participants, 100000),
                     transports[i], InmemAppProxy())
@@ -397,7 +401,7 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
         # compiles — so the gate requires enough committed events to
         # prove MATURE steady state, under a generous cap.
         deadline = time.monotonic() + warm_s
-        while time.monotonic() < deadline and committed() < 1500:
+        while time.monotonic() < deadline and committed() < warm_gate_events:
             time.sleep(0.5)
         c0, t0 = committed(), time.monotonic()
         time.sleep(window_s)
@@ -408,8 +412,12 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
         for nd in nodes:
             nd.shutdown()
     if c1 <= c0:
+        # A min-count REGRESSION means a lagging node fast-forwarded
+        # (its store resets to the frame, see node.py _fast_forward),
+        # which is healthy behavior but invalidates this window.
         raise RuntimeError(
-            f"testnet made no progress in the window ({c0} -> {c1})")
+            f"testnet window invalid ({c0} -> {c1}; fast-forward reset "
+            "or stall)")
     return (c1 - c0) / (t1 - t0)
 
 
@@ -613,8 +621,13 @@ def child():
                 log(f"  node host stage failed: {exc}")
         if _budget_left() > 450 and not on_cpu:
             try:
+                # Generous gate: the engine's window shapes keep
+                # drifting (compiling) for the first few thousand
+                # committed events; measuring earlier catches compile
+                # stalls in the window (A/B: 285 vs 480+ ev/s).
                 node_eps = node_testnet_events_per_sec(
-                    engine="tpu", warm_s=210.0, window_s=75.0)
+                    engine="tpu", warm_s=300.0, window_s=75.0,
+                    warm_gate_events=6000)
                 log(f"  4-node --engine tpu testnet (one shared chip): "
                     f"{node_eps:,.1f} committed events/s")
                 payload["node_tpu_events_per_s"] = round(node_eps, 1)
