@@ -64,18 +64,11 @@ def cmd_run(args) -> int:
         # Persistent XLA compile cache: a restarting node (and every
         # node of a localhost testnet) reuses compiled consensus
         # kernels instead of paying tens of seconds of recompiles.
-        import jax
+        # (Core wires this too; doing it before any JAX import settles
+        # the config as early as possible.)
+        from .devices import ensure_compile_cache
 
-        cache_dir = os.environ.get(
-            "JAX_COMPILATION_CACHE_DIR",
-            os.path.join(
-                os.path.expanduser("~"), ".cache", "babble_tpu", "jax"))
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        # 1s floor: trivial kernels recompile fast anyway, and
-        # persisting every one grows the cache dir without bound.
-        jax.config.update(
-            "jax_persistent_cache_min_compile_time_secs", 1.0)
+        ensure_compile_cache()
 
     datadir = args.datadir
     key = PemKey(datadir).read_key()
@@ -102,6 +95,8 @@ def cmd_run(args) -> int:
             args.consensus_interval / 1000.0
             if args.consensus_interval is not None
             else (0.25 if args.engine == "tpu" else 0.0)),
+        pipeline_depth=args.pipeline_depth,
+        engine_prewarm=not args.no_prewarm,
         logger=logger,
     )
 
@@ -197,6 +192,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "default 0 for --engine host, 250 for tpu — "
                          "the FLOOR of an adaptive cadence that tracks "
                          "~3x the measured device-pass wall)")
+    rn.add_argument("--pipeline_depth", type=int, default=1,
+                    help="consensus pipeline depth for the tpu engine "
+                         "(1 = overlapped: a pass is dispatched and its "
+                         "commit delta collected on the next worker "
+                         "wake, so device compute overlaps gossip "
+                         "ingest; 0 = synchronous dispatch+collect)")
+    rn.add_argument("--no_prewarm", action="store_true",
+                    help="skip compiling the engine's cold-start kernel "
+                         "ladder at boot (tpu engine)")
     rn.set_defaults(fn=cmd_run)
 
     vs = sub.add_parser("version", help="print version")

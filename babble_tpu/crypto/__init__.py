@@ -1,4 +1,5 @@
 from .keys import (
+    BACKEND,
     generate_key,
     key_from_seed,
     pub_key_bytes,
@@ -10,6 +11,7 @@ from .keys import (
 from .pem import PemKey, generate_pem_key, PemDump
 
 __all__ = [
+    "BACKEND",
     "generate_key",
     "key_from_seed",
     "pub_key_bytes",

@@ -3,8 +3,12 @@
 Reference: crypto/utils.go:11-44 — SHA-256 digests; ECDSA over NIST
 P-256 with signatures as the (R, S) big-int pair; public keys serialized
 as uncompressed X9.62 points (0x04||X||Y, 65 bytes — Go
-elliptic.Marshal). Backed by the `cryptography` package (OpenSSL) rather
-than a slow pure-Python field implementation.
+elliptic.Marshal).
+
+Backend selection: the `cryptography` package (OpenSSL) when available,
+else the pure-Python fallback (`_fallback.py`) — same wire formats,
+signatures interchangeable. `BACKEND` reports which one is active;
+the import never fails on a missing optional dependency.
 """
 
 from __future__ import annotations
@@ -12,17 +16,24 @@ from __future__ import annotations
 import hashlib
 from typing import Tuple
 
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    Prehashed,
-    decode_dss_signature,
-    encode_dss_signature,
-)
-from cryptography.hazmat.primitives.serialization import Encoding, PublicFormat
+try:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed,
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
 
-_CURVE = ec.SECP256R1()
-_PREHASHED = ec.ECDSA(Prehashed(hashes.SHA256()))
+    BACKEND = "openssl"
+except ImportError:  # pure-Python fallback, no optional deps
+    from . import _fallback as _fb
+
+    BACKEND = "pure-python"
 
 # P-256 group order: private scalars are in [1, N-1].
 _P256_ORDER = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
@@ -32,35 +43,45 @@ def sha256(data: bytes) -> bytes:
     return hashlib.sha256(data).digest()
 
 
-def generate_key() -> ec.EllipticCurvePrivateKey:
-    return ec.generate_private_key(_CURVE)
+if BACKEND == "openssl":
+    _CURVE = ec.SECP256R1()
+    _PREHASHED = ec.ECDSA(Prehashed(hashes.SHA256()))
 
+    def generate_key() -> ec.EllipticCurvePrivateKey:
+        return ec.generate_private_key(_CURVE)
 
-def key_from_seed(seed: int) -> ec.EllipticCurvePrivateKey:
-    """Deterministic key for tests/simulations (not in the reference, which
-    always draws from crypto/rand)."""
-    scalar = (seed % (_P256_ORDER - 1)) + 1
-    return ec.derive_private_key(scalar, _CURVE)
+    def key_from_seed(seed: int) -> ec.EllipticCurvePrivateKey:
+        """Deterministic key for tests/simulations (not in the
+        reference, which always draws from crypto/rand)."""
+        scalar = (seed % (_P256_ORDER - 1)) + 1
+        return ec.derive_private_key(scalar, _CURVE)
 
+    def pub_key_bytes(key: ec.EllipticCurvePrivateKey) -> bytes:
+        """Uncompressed point, 65 bytes — same as Go elliptic.Marshal."""
+        return key.public_key().public_bytes(
+            Encoding.X962, PublicFormat.UncompressedPoint)
 
-def pub_key_bytes(key: ec.EllipticCurvePrivateKey) -> bytes:
-    """Uncompressed point, 65 bytes — same as Go elliptic.Marshal."""
-    return key.public_key().public_bytes(Encoding.X962, PublicFormat.UncompressedPoint)
+    def pub_key_from_bytes(pub: bytes) -> ec.EllipticCurvePublicKey:
+        return ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, pub)
 
+    def sign(key: ec.EllipticCurvePrivateKey, digest: bytes) -> Tuple[int, int]:
+        """Sign a precomputed digest; returns (R, S) — reference
+        crypto/utils.go:38."""
+        der = key.sign(digest, _PREHASHED)
+        return decode_dss_signature(der)
 
-def pub_key_from_bytes(pub: bytes) -> ec.EllipticCurvePublicKey:
-    return ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, pub)
+    def verify(pub: ec.EllipticCurvePublicKey, digest: bytes,
+               r: int, s: int) -> bool:
+        try:
+            pub.verify(encode_dss_signature(r, s), digest, _PREHASHED)
+            return True
+        except Exception:
+            return False
 
-
-def sign(key: ec.EllipticCurvePrivateKey, digest: bytes) -> Tuple[int, int]:
-    """Sign a precomputed digest; returns (R, S) — reference crypto/utils.go:38."""
-    der = key.sign(digest, _PREHASHED)
-    return decode_dss_signature(der)
-
-
-def verify(pub: ec.EllipticCurvePublicKey, digest: bytes, r: int, s: int) -> bool:
-    try:
-        pub.verify(encode_dss_signature(r, s), digest, _PREHASHED)
-        return True
-    except Exception:
-        return False
+else:
+    generate_key = _fb.generate_key
+    key_from_seed = _fb.key_from_seed
+    pub_key_bytes = _fb.pub_key_bytes
+    pub_key_from_bytes = _fb.pub_key_from_bytes
+    sign = _fb.sign
+    verify = _fb.verify

@@ -3,6 +3,10 @@
 Reference: crypto/pem_key.go:14-99 — `priv_key.pem` holding a SEC1
 "EC PRIVATE KEY" block; `GeneratePemKey` returns the public key as
 "0x"-prefixed uppercase hex of the uncompressed point plus the PEM text.
+
+Works on either crypto backend (see keys.BACKEND): OpenSSL-backed keys
+serialize through `cryptography`, the pure-Python fallback emits the
+same RFC 5915 DER itself — the PEM files are interchangeable.
 """
 
 from __future__ import annotations
@@ -10,35 +14,47 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass
 
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.serialization import (
-    Encoding,
-    NoEncryption,
-    PrivateFormat,
-    load_pem_private_key,
-)
+from .keys import BACKEND, generate_key, pub_key_bytes
 
-from .keys import generate_key, pub_key_bytes
+if BACKEND == "openssl":
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        NoEncryption,
+        PrivateFormat,
+        load_pem_private_key,
+    )
+else:
+    from . import _fallback as _fb
 
 PEM_KEY_PATH = "priv_key.pem"
 
 
-def _key_to_pem(key: ec.EllipticCurvePrivateKey) -> bytes:
-    # TraditionalOpenSSL for EC == SEC1 "EC PRIVATE KEY", same as Go
-    # x509.MarshalECPrivateKey.
-    return key.private_bytes(Encoding.PEM, PrivateFormat.TraditionalOpenSSL, NoEncryption())
+def _key_to_pem(key) -> bytes:
+    if BACKEND == "openssl":
+        # TraditionalOpenSSL for EC == SEC1 "EC PRIVATE KEY", same as Go
+        # x509.MarshalECPrivateKey.
+        return key.private_bytes(
+            Encoding.PEM, PrivateFormat.TraditionalOpenSSL, NoEncryption())
+    return _fb.key_to_pem(key)
+
+
+def _key_from_pem(data: bytes):
+    if BACKEND == "openssl":
+        return load_pem_private_key(data, password=None)
+    return _fb.key_from_pem(data)
 
 
 class PemKey:
     def __init__(self, base: str):
         self.path = os.path.join(base, PEM_KEY_PATH)
 
-    def read_key(self) -> ec.EllipticCurvePrivateKey:
+    def read_key(self):
         with open(self.path, "rb") as f:
             data = f.read()
-        return load_pem_private_key(data, password=None)
+        return _key_from_pem(data)
 
-    def write_key(self, key: ec.EllipticCurvePrivateKey) -> None:
+    def write_key(self, key) -> None:
         with open(self.path, "wb") as f:
             f.write(_key_to_pem(key))
 

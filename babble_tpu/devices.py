@@ -65,3 +65,26 @@ def ensure_virtual_devices(count: int) -> None:
         + f"set XLA_FLAGS=--xla_force_host_platform_device_count={count} "
         "JAX_PLATFORMS=cpu before starting python"
     )
+
+
+def ensure_compile_cache(path: str | None = None) -> str:
+    """Point JAX's persistent compilation cache at a stable directory
+    so node restarts (and every process of a localhost testnet) reuse
+    compiled consensus kernels instead of re-paying tens of seconds of
+    XLA compiles. Idempotent; an explicit JAX_COMPILATION_CACHE_DIR or
+    an already-configured directory wins."""
+    import jax
+
+    configured = jax.config.jax_compilation_cache_dir
+    if configured:
+        return configured
+    cache_dir = path or os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "babble_tpu", "jax"),
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # 0.1s floor: engine kernels are worth persisting even when a fast
+    # backend compiles them quickly; trivial one-liners are not.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    return cache_dir

@@ -50,6 +50,7 @@ class TpuHashgraph(Hashgraph):
         k_capacity: int = 64,
         mesh=None,
         mesh_axis: str = "sp",
+        prewarm: bool = False,
     ):
         super().__init__(participants, store, commit_callback)
         self._capacity = capacity
@@ -60,6 +61,11 @@ class TpuHashgraph(Hashgraph):
         self.engine = IncrementalEngine(
             len(participants), capacity=capacity, block=block,
             k_capacity=k_capacity, mesh=mesh, mesh_axis=mesh_axis)
+        if prewarm:
+            # Compile the cold-start kernel ladder now (scratch sibling
+            # engine, process-global jit caches) so the first live
+            # syncs hit warm caches instead of multi-second stalls.
+            self.engine.prewarm()
         self._eid_of: Dict[str, int] = {}
         # eid -> hex only; Event objects stay in the Store so its cache
         # bound (not this map) governs host memory.
@@ -107,6 +113,22 @@ class TpuHashgraph(Hashgraph):
     def run_consensus(self, unlocked=None) -> None:
         delta = self.engine.run(unlocked=unlocked)
         self._apply_delta(delta)
+
+    # Async pipeline seam (node/_consensus_loop with pipeline_depth >
+    # 0): dispatch enqueues the whole device pass and returns
+    # immediately; collect blocks only on the packed commit-delta pull
+    # and mirrors it into the Store. Between the two calls gossip keeps
+    # inserting — ingest of pass k+1 overlaps device compute of pass k.
+
+    def dispatch_consensus(self, unlocked=None):
+        return self.engine.dispatch(unlocked=unlocked)
+
+    def collect_consensus(self, pending, unlocked=None) -> None:
+        delta = self.engine.collect(pending, unlocked=unlocked)
+        self._apply_delta(delta)
+
+    def abandon_consensus(self, pending) -> None:
+        self.engine.abandon(pending)
 
     def divide_rounds(self) -> None:  # test-surface compatibility
         self.run_consensus()
@@ -230,6 +252,7 @@ class TpuHashgraph(Hashgraph):
         frame events then append at position 0 exactly as a fresh
         graph's do."""
         super().reset(roots)
+        self.engine.close()  # stop the old engine's staging worker
         n = len(self.participants)
         root_round = np.full(n, -1, np.int32)
         index_base = np.zeros(n, np.int32)

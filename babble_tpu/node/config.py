@@ -45,6 +45,23 @@ class Config:
     # The reference needs no such bound because its gossip is fully
     # serialized with RunConsensus (node/node.go:467-487).
     engine_backlog_limit: int = 1024
+    # Consensus pipeline depth for the device engine (requires
+    # consensus_interval > 0). 0 = synchronous: each worker wake runs
+    # dispatch + collect back to back (the host blocks on the device
+    # round trip). 1 = overlapped (default): the worker dispatches a
+    # pass and returns; the commit delta is collected on the NEXT wake,
+    # so the device computes pass k while gossip stages the appends of
+    # pass k+1 (double-buffered in the engine) — the device round trip
+    # leaves the hot path entirely. Depths > 1 are reserved: pass k+1's
+    # window inputs read pass k's committed result carries, so only one
+    # pass can be in flight per engine.
+    pipeline_depth: int = 1
+    # Compile the device engine's cold-start kernel ladder at node
+    # construction (IncrementalEngine.prewarm) instead of stalling the
+    # first live syncs on it. Skipped automatically when the scratch
+    # sibling engine would transiently exceed the prewarm memory
+    # budget (very large n).
+    engine_prewarm: bool = True
     logger: logging.Logger = field(default_factory=_default_logger)
 
 

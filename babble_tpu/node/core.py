@@ -31,6 +31,7 @@ class Core:
         commit_callback: Optional[Callable[[Block], None]] = None,
         engine: str = "host",
         engine_mesh: int = 0,
+        engine_prewarm: bool = False,
     ):
         self.id = id
         self.key = key
@@ -39,7 +40,14 @@ class Core:
         if engine == "tpu":
             # Device-backed consensus behind the same seam — the
             # JaxStore-sibling integration of SURVEY §7 step 3.
+            from ..devices import ensure_compile_cache
             from ..hashgraph.tpu_graph import TpuHashgraph
+
+            # Persistent XLA compile cache for EVERY tpu-engine node
+            # (not just the CLI path): restarts — and each process of a
+            # localhost testnet — reuse compiled consensus kernels
+            # instead of re-paying tens of seconds of compiles.
+            ensure_compile_cache()
 
             mesh = None
             if engine_mesh and engine_mesh > 1:
@@ -81,7 +89,8 @@ class Core:
             k_cap = max(64, min(cap, (1 << 28) // (4 * n_p * n_p)))
             self.hg: Hashgraph = TpuHashgraph(
                 participants, store, commit_callback, mesh=mesh,
-                capacity=cap, block=512, k_capacity=k_cap)
+                capacity=cap, block=512, k_capacity=k_cap,
+                prewarm=engine_prewarm)
         elif engine == "host":
             self.hg = Hashgraph(participants, store, commit_callback)
         else:
@@ -262,15 +271,58 @@ class Core:
         t0 = time.perf_counter_ns()
         self.hg.run_consensus(unlocked=unlocked)
         self._timed("run_consensus", t0)
-        # Device-engine sub-phases (coords/fd/frontier/fame/rr) when the
-        # batched pipeline is active.
+        self._merge_engine_phases()
+
+    # -- async consensus pipeline (device engine only) ----------------------
+
+    def supports_pipeline(self) -> bool:
+        """True when the hashgraph engine exposes the dispatch/collect
+        split (the batched device engine); the host engine runs
+        consensus inline with each sync."""
+        return hasattr(self.hg, "dispatch_consensus")
+
+    def dispatch_consensus(self, unlocked=None):
+        """Enqueue one full consensus pass on device and return its
+        PendingPass immediately (None when there is nothing to do) —
+        no device round trip happens here."""
+        t0 = time.perf_counter_ns()
+        pending = self.hg.dispatch_consensus(unlocked=unlocked)
+        self._timed("consensus_dispatch", t0)
+        return pending
+
+    def collect_consensus(self, pending, unlocked=None) -> None:
+        """Block on the pass's commit-delta pull and mirror the result
+        into the Store. The only blocking device wait of the pass."""
+        if pending is None:
+            return
+        t0 = time.perf_counter_ns()
+        self.hg.collect_consensus(pending, unlocked=unlocked)
+        self._timed("consensus_collect", t0)
+        self._merge_engine_phases()
+
+    def abandon_consensus(self, pending) -> None:
+        if pending is not None and hasattr(self.hg, "abandon_consensus"):
+            self.hg.abandon_consensus(pending)
+
+    def _merge_engine_phases(self) -> None:
+        # Device-engine sub-phases (coords/fd/fused dispatch/pull/
+        # apply) when the batched pipeline is active, plus the overlap
+        # diagnostic: device compute the host never waited for.
         engine = getattr(self.hg, "engine", None)
-        if engine is not None and getattr(engine, "phase_ns", None):
+        if engine is None:
+            return
+        if getattr(engine, "phase_ns", None):
             for ph, ns in engine.phase_ns.items():
                 ent = self.phase_ns.setdefault(f"engine_{ph}", [0, 0, 0])
                 ent[0] = ns
                 ent[1] += ns
                 ent[2] += 1
+        overlap = getattr(engine, "last_overlap_ns", 0)
+        if overlap:
+            ent = self.phase_ns.setdefault("engine_overlap", [0, 0, 0])
+            ent[0] = overlap
+            ent[1] += overlap
+            ent[2] += 1
 
     def add_transactions(self, txs: List[bytes]) -> None:
         self.transaction_pool.extend(txs)

@@ -48,6 +48,8 @@ from __future__ import annotations
 
 import bisect
 import functools
+import queue
+import threading
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
@@ -187,6 +189,56 @@ def _chain_ingest(chain_d, chain_th, chain_tl, newtab, newpos,
 _FD_CHUNK_ELEMS = 1 << 26
 
 
+def _tables_chain_write(chain_la, chain_rb, la, rb, newtab, newpos,
+                        *, n, m, k):
+    """Shared prologue of the fd-fold variants: write the batch rows
+    into the resident chain_la/chain_rb tables and return the
+    effective la rows (INT32_MAX in pad lanes)."""
+    cap1 = la.shape[0]
+    valid = newtab >= 0
+    ids = jnp.where(valid, newtab, cap1 - 1)  # sentinel row, masked below
+    la_new = la[ids]  # [n, m, n]
+    rb_new = rb[ids]  # [n, m]
+    pos = jnp.where(valid, newpos, k)  # OOB -> dropped
+    crows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, m))
+    chain_la = chain_la.at[crows, pos].set(
+        jnp.where(valid[:, :, None], la_new, INT32_MAX), mode="drop")
+    chain_rb = chain_rb.at[crows, pos].set(
+        jnp.where(valid, rb_new, INT32_MAX), mode="drop")
+    la_eff = jnp.where(valid[:, :, None], la_new, INT32_MAX)  # [n, m, n]
+    return chain_la, chain_rb, la_eff
+
+
+@functools.partial(jax.jit, static_argnames=("n", "m"),
+                   donate_argnums=(0, 1, 2))
+def _tables_update_hist(ranks, chain_la, chain_rb, la, rb, newtab,
+                        newpos, *, n, m):
+    """Histogram + cumulative-sum form of the fd-rank fold —
+    O(batch·n scatter + n^2·K cumsum) work instead of the broadcast
+    form's O(batch·n^2·K) compares. The scatter-add serializes on TPU
+    (which is why _tables_update exists), but on CPU/GPU backends it
+    is the difference between milliseconds and seconds per pass; the
+    engine picks per backend at construction.
+
+    Bucketing: la = -1 counts for every t >= 0 (bucket 0), la = v >= 0
+    counts for t > v (bucket v+1), pad lanes (INT32_MAX) clip to
+    bucket K and never land inside the cumsum's [0, K) window — the
+    exact contract of the broadcast form's clip(la+1, 0, k)."""
+    k = ranks.shape[2]
+    chain_la, chain_rb, la_eff = _tables_chain_write(
+        chain_la, chain_rb, la, rb, newtab, newpos, n=n, m=m, k=k)
+    # Clip BEFORE the +1: pad lanes are INT32_MAX and la_eff + 1 would
+    # wrap to INT32_MIN, landing them in bucket 0 (= counted for every
+    # t) instead of the never-counted bucket k.
+    b = jnp.clip(la_eff, -1, k - 1) + 1  # [n, m, n] buckets
+    h = jnp.zeros((n, n, k + 1), jnp.int32)
+    crows = jnp.arange(n)[:, None, None]
+    icols = jnp.arange(n)[None, None, :]
+    h = h.at[crows, icols, b].add(1)
+    ranks = ranks + jnp.cumsum(h, axis=2)[:, :, :k]
+    return ranks, chain_la, chain_rb
+
+
 @functools.partial(jax.jit, static_argnames=("n", "m"),
                    donate_argnums=(0, 1, 2))
 def _tables_update(ranks, chain_la, chain_rb, la, rb, newtab, newpos,
@@ -205,27 +257,18 @@ def _tables_update(ranks, chain_la, chain_rb, la, rb, newtab, newpos,
         [batch, K] compare cube's O(batch·n^2·K).
     """
     k = ranks.shape[2]
-    cap1 = la.shape[0]
-    valid = newtab >= 0
-    ids = jnp.where(valid, newtab, cap1 - 1)  # sentinel row, masked below
-    la_new = la[ids]  # [n, m, n]
-    rb_new = rb[ids]  # [n, m]
-    pos = jnp.where(valid, newpos, k)  # OOB -> dropped
-    crows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, m))
-    chain_la = chain_la.at[crows, pos].set(
-        jnp.where(valid[:, :, None], la_new, INT32_MAX), mode="drop")
-    chain_rb = chain_rb.at[crows, pos].set(
-        jnp.where(valid, rb_new, INT32_MAX), mode="drop")
+    chain_la, chain_rb, la_eff = _tables_chain_write(
+        chain_la, chain_rb, la, rb, newtab, newpos, n=n, m=m, k=k)
 
     # Broadcast-compare-reduce: delta[c, i, t] = #{new j on chain c :
     # la_new[c, j, i] < t}. FLOP-wise this is O(batch·n·K) against the
     # histogram+cumsum's O(n^2·K), but it is pure compare+sum — XLA
     # fuses it into a stream with no scatter and no scan, and on TPU
     # the scatter-add histogram serialized into the per-sync bottleneck
-    # (measured 347 ms/pass at n=1024 vs ~40 ms for this form).
+    # (measured 347 ms/pass at n=1024 vs ~40 ms for this form; CPU/GPU
+    # backends take _tables_update_hist instead).
     # Invalid lanes compare as INT32_MAX and never count; la = -1
     # counts for every t >= 0, matching clip(la+1, 0, k) bucketing.
-    la_eff = jnp.where(valid[:, :, None], la_new, INT32_MAX)  # [n, m, n]
     ic = max(min(_FD_CHUNK_ELEMS // max(m * k, 1), n), 1)
     while n % ic:
         ic -= 1
@@ -495,6 +538,13 @@ def _consensus_fused(chain_la, chain_rb_tab, chain_len, la, ranks, rb_vec,
     return packed, rounds_all, rr_all
 
 
+# Shape-keys already prewarmed this process (see
+# IncrementalEngine.prewarm): jit caches are process-global, so one
+# warm engine covers every same-shaped sibling (a localhost testnet's
+# nodes, a reset engine, tests that rebuild graphs per fixture).
+_PREWARM_DONE: set = set()
+
+
 @dataclass
 class RunDelta:
     """What one run() call newly decided — the exact shape of the
@@ -510,13 +560,48 @@ class RunDelta:
     last_commited_round_events: int = 0
 
 
+class PendingPass:
+    """One dispatched-but-uncollected consensus pass.
+
+    Created by dispatch(), consumed exactly once by collect() (or
+    abandon()). Carries the pass SNAPSHOT (batch ids, sizes, chain
+    lengths), the staged device inputs the redo loop re-dispatches
+    against, and the in-flight device result handles. Everything here
+    is immutable from the engine's point of view until collect — the
+    double-buffer contract: appends landing while the pass is in
+    flight go to the engine's fresh staging list, never this one.
+    """
+
+    __slots__ = (
+        "new_ids", "e", "cap0", "k0", "chain_len0",
+        "chain_len_d", "la", "rb", "cr_d", "idx_d", "coin_d",
+        "t0", "wt_prev", "fr_prev", "rel_rows",
+        "e0_b", "bp", "rounds_up", "rr_up",
+        "und", "und_up", "n_und", "au",
+        "undecided_set", "rx0",
+        "w_floor", "tw_floor", "rw", "iw", "cb", "tw", "rcap",
+        "tw_i", "t_start",
+        "packed_dev", "rounds_out", "rr_out",
+        "dispatched_ns", "stage_tail_ns",
+        "ready", "error",
+    )
+
+
 class IncrementalEngine:
     """Growable device-resident DAG + amortized consensus pipeline.
 
     append()/append_batch() stage events on the host (numpy mirrors with
-    capacity doubling); run() executes the incremental pipeline and
-    returns a RunDelta. Query helpers serve from the host mirrors of the
-    last run's results.
+    capacity doubling). The pass itself is split for pipelining:
+    dispatch() snapshots the staged batch, enqueues every device step
+    (growth pads, ingest, closure, fd fold, and the fused consensus
+    epilogue) WITHOUT any device->host round trip, and returns a
+    PendingPass immediately; collect() blocks only on the packed
+    commit-delta pull, applies the host mirrors, and returns a
+    RunDelta. run() = dispatch + collect, the synchronous spelling.
+    While a pass is in flight appends keep landing in a fresh staging
+    list (double buffering), so ingest of pass k+1 overlaps device
+    compute of pass k. Query helpers serve from the host mirrors of
+    the last collected pass.
     """
 
     def __init__(self, n: int, root_round=None, *, capacity: int = 256,
@@ -650,6 +735,40 @@ class IncrementalEngine:
 
         self._new_since_run: List[int] = []
         self._empty_delta_ok = False  # True when state is at a fixpoint
+        # The at-most-one in-flight pass (see PendingPass): dispatch
+        # sets it, collect/abandon clear it. Pass k+1's window inputs
+        # read pass k's committed carries, so two passes can never
+        # overlap on device.
+        self._inflight: Optional[PendingPass] = None
+        # Staging worker (see dispatch()): device enqueues happen on a
+        # dedicated thread because enqueue itself can block the caller
+        # — the CPU client throttles at a fixed in-flight computation
+        # count, and a tunneled TPU blocks on transfer backpressure —
+        # and the whole point of the async pipeline is that the host
+        # never waits except at delta-fetch.
+        self._stage_q: Optional[queue.Queue] = None
+        self._stage_thread: Optional[threading.Thread] = None
+        self._stage_lock = threading.Lock()
+        # fd-rank fold variant: the broadcast compare-and-count streams
+        # on the MXU; every other backend takes the histogram form
+        # (FLOP count lower by the batch factor; scatter-add is fine
+        # off-TPU).
+        backend = jax.default_backend()
+        self._tables_fn = (
+            _tables_update if backend == "tpu" else _tables_update_hist)
+        # Window-floor ceiling: the big floors exist to collapse the
+        # fused kernel's compile space on the tunneled TPU, where every
+        # distinct static shape stalls the node for tens of seconds.
+        # Off-TPU a compile is a couple of cached-persistent seconds,
+        # and the fame/rr loops cost per SEQUENTIAL STEP — a 128-row
+        # floor at n=64 runs ~10x more steps than the actual round
+        # movement needs. Small floor => tight windows => the fused
+        # kernel's step count tracks real work.
+        self._w_floor_max = 256 if backend == "tpu" else 16
+        # Overlap diagnostics of the last collected pass: wall between
+        # dispatch return and collect entry (work the device did while
+        # the host was free), and the blocking share of the pull.
+        self.last_overlap_ns = 0
 
         # Per-phase wall time (ns) of the last run(), mirroring the
         # reference's phase logging around the consensus pipeline
@@ -898,42 +1017,164 @@ class IncrementalEngine:
             jnp.asarray(newhi), jnp.asarray(newlo), n=n, m=m)
 
     def run(self, *, unlocked=None) -> RunDelta:
-        """Run one incremental consensus pass.
+        """Run one synchronous incremental consensus pass:
+        dispatch() + collect() back to back.
 
         `unlocked` (optional): a context manager factory. When given,
-        the engine releases it ONLY around the blocking device-result
-        wait — a live node passes a core-lock release so gossip keeps
-        inserting at wire speed while the chip computes. This is safe
-        because the pass operates on a SNAPSHOT taken under the lock:
-        the batch ids, e/cap/kcap, and chain lengths are captured
-        before dispatch, every device input is uploaded before the
-        wait, and the post-pull mirror section only touches state that
+        the engine releases it around the device sections — a live
+        node passes a core-lock release so gossip keeps inserting at
+        wire speed while the chip computes. This is safe because the
+        pass operates on a SNAPSHOT taken under the lock: the batch
+        ids, e/cap/kcap, and chain lengths are captured before
+        dispatch, every device input is uploaded before the wait, and
+        the post-pull mirror section only touches state that
         concurrent append() never reads or writes.
         """
-        if self.e == 0 or (self._empty_delta_ok and not self._new_since_run):
-            # No-op runs must not leave stale phase timings for callers
-            # that aggregate them (node/core.py).
-            self.phase_ns = {}
+        pp = self.dispatch(unlocked=unlocked)
+        if pp is None:
             return RunDelta(last_consensus_round=self.last_consensus_round)
+        return self.collect(pp, unlocked=unlocked)
+
+    # -- the async pipeline: dispatch / collect -----------------------------
+
+    def dispatch(self, *, unlocked=None) -> Optional[PendingPass]:
+        """Snapshot the appended batch and hand one full consensus
+        pass — growth pads, ingest, closure, fd fold, and the fused
+        commit-delta epilogue — to the staging worker thread, returning
+        a PendingPass IMMEDIATELY. Returns None when there is nothing
+        to do.
+
+        The device enqueues happen off-thread because enqueue itself
+        can block the caller (the CPU client throttles at a fixed
+        in-flight computation count; a tunneled TPU blocks on transfer
+        backpressure), and the pipeline's contract is that the host
+        waits only at delta-fetch. `unlocked` is accepted for API
+        symmetry with collect() but unused — dispatch no longer does
+        anything slow under the caller's lock.
+
+        At most one pass may be in flight: the epilogue's window
+        inputs read the previous pass's COMMITTED result carries, and
+        commit happens in collect(). While the pass is in flight,
+        append() keeps staging into a fresh list (double buffering),
+        so ingest of pass k+1 overlaps device compute of pass k.
+        """
+        del unlocked
+        if self._inflight is not None:
+            raise RuntimeError("a consensus pass is already in flight")
+        if self.e == 0 or (self._empty_delta_ok and not self._new_since_run):
+            # No-op dispatches must not leave stale phase timings for
+            # callers that aggregate them (node/core.py).
+            self.phase_ns = {}
+            return None
         new_ids = self._new_since_run
         self._new_since_run = []
         try:
-            return self._run_pass(new_ids, unlocked)
+            pp = PendingPass()
+            pp.new_ids = new_ids
+            # Snapshot (see run() docstring): the staging worker and
+            # collect must use these, not the live fields, since
+            # appends interleave with everything past this point.
+            pp.e = self.e
+            pp.cap0, pp.k0 = self.cap, self.kcap
+            pp.chain_len0 = self.chain_len.copy()
+            pp.ready = threading.Event()
+            pp.error = None
+            self._submit_stage(pp)
         except BaseException:
-            # Retry safety: a transient device failure (tunnel drop,
-            # preemption) must not orphan the batch's host mirroring —
-            # restore the snapshot (appends that landed during the
-            # unlocked wait follow it) so the next pass redoes it.
+            # Retry safety: a transient failure must not orphan the
+            # batch's host mirroring — restore the snapshot so the
+            # next pass redoes it.
             self._new_since_run = new_ids + self._new_since_run
             raise
+        self._inflight = pp
+        return pp
 
-    def _run_pass(self, new_ids, unlocked) -> RunDelta:
-        n, sm, e = self.n, self.sm, self.e
-        # Snapshot (see run() docstring): everything below must use
-        # these, not the live fields, once the unlocked wait can
-        # interleave appends.
-        cap0, k0 = self.cap, self.kcap
-        chain_len0 = self.chain_len.copy()
+    def _submit_stage(self, pp: PendingPass) -> None:
+        with self._stage_lock:
+            if self._stage_thread is None or not self._stage_thread.is_alive():
+                self._stage_q = queue.Queue()
+                self._stage_thread = threading.Thread(
+                    target=self._stage_worker, args=(self._stage_q,),
+                    daemon=True, name="babble-engine-stager")
+                self._stage_thread.start()
+            self._stage_q.put(pp)
+
+    def _stage_worker(self, q: "queue.Queue") -> None:
+        while True:
+            try:
+                pp = q.get(timeout=60.0)
+            except queue.Empty:
+                # Idle exit (bench/test engines come and go); the
+                # submit path restarts a worker on demand. The lock
+                # makes exit-vs-put atomic: a pass put while we decide
+                # is either seen here or starts a fresh worker.
+                with self._stage_lock:
+                    if not q.empty():
+                        continue
+                    if self._stage_thread is threading.current_thread():
+                        self._stage_thread = None
+                    return
+            if pp is None:
+                return
+            try:
+                self._stage_pass(pp)
+            except BaseException as exc:  # noqa: BLE001 - relayed to collect
+                pp.error = exc
+            finally:
+                pp.ready.set()
+
+    def close(self) -> None:
+        """Stop the staging worker (idle workers also exit on their
+        own). Safe to call repeatedly; a later dispatch restarts it."""
+        with self._stage_lock:
+            if self._stage_thread is not None and self._stage_q is not None:
+                self._stage_q.put(None)
+                self._stage_thread = None
+
+    def collect(self, pp: Optional[PendingPass], *,
+                unlocked=None) -> RunDelta:
+        """Fetch the commit delta of an in-flight pass — the ONE
+        blocking device->host wait of the pass — apply the host
+        mirrors, commit the device result carries, and return the
+        RunDelta. Window-overflow redos re-dispatch the fused epilogue
+        from the snapshot still held by the PendingPass."""
+        if pp is None:
+            return RunDelta(last_consensus_round=self.last_consensus_round)
+        if pp is not self._inflight:
+            raise RuntimeError("collect() of a pass that is not in flight")
+        self._inflight = None
+        try:
+            return self._collect_pass(pp, unlocked)
+        except BaseException:
+            self._new_since_run = pp.new_ids + self._new_since_run
+            raise
+
+    def abandon(self, pp: Optional[PendingPass]) -> None:
+        """Drop an in-flight pass without applying it: the batch goes
+        back to the staging list and the next pass redoes it — the same
+        contract as the exception paths (result carries are only ever
+        committed by a successful collect)."""
+        if pp is None or pp is not self._inflight:
+            return
+        self._inflight = None
+        self._new_since_run = pp.new_ids + self._new_since_run
+
+    @property
+    def inflight(self) -> bool:
+        return self._inflight is not None
+
+    def _stage_pass(self, pp: PendingPass) -> None:
+        """The staging half of a pass, run on the worker thread: parts
+        0-2 (device sync-up, ingest, closure, fd fold), the window
+        derivation, and the fused-epilogue dispatch. Reads only the
+        pass snapshot plus host state that collect alone mutates —
+        concurrent append() is safe by the snapshot discipline (see
+        run() docstring)."""
+        n = self.n
+        new_ids = pp.new_ids
+        e = pp.e
+        cap0, k0 = pp.cap0, pp.k0
+        chain_len0 = pp.chain_len0
         import os as _os
         import time as _time
 
@@ -955,247 +1196,295 @@ class IncrementalEngine:
             self.phase_ns[name] = now - _phase_start
             _phase_start = now
 
-        # The WHOLE device section — growth pads, ingest, closure,
-        # fd, and the fused-kernel redo loop with its pull — runs
-        # with the caller's lock RELEASED: under a contended tunnel
-        # even the dispatch call can block for seconds (transfer
-        # backpressure), and holding the core lock there froze
-        # gossip for whole passes. Every read below is covered by
-        # the snapshot discipline (see run() docstring): appends
-        # only touch rows at/beyond the snapshot, and the growth
-        # helpers replace host arrays instead of resizing them.
+        # 0. Device sync-up: lazy capacity growth, then ingest the new
+        # batch into the resident event arrays and chain table. All
+        # dispatches are async — nothing here round-trips. Under a mesh,
+        # re-pin the carries first (growth concats and kernel outputs
+        # may drift from the intended shardings).
+        self._sync_device(cap0, k0)
+        self._constrain_carries()
+        self._ingest_batch(e, chain_len0)
+        pp.chain_len_d = jnp.asarray(chain_len0)
+        pp.cr_d = self._cr_d
+        pp.idx_d = self._idx_d
+        pp.coin_d = self._coin_d
+
+        # 1. Coordinates: only blocks the frozen prefix doesn't cover.
+        nb = (e + self.block - 1) // self.block
+        self._la, self._rb = _closure_update(
+            self._la, self._rb, self._sp_d, self._op_d, pp.cr_d,
+            pp.idx_d, self._rb0_d, jnp.int32(self._frozen_blocks),
+            jnp.int32(nb), n=n, block=self.block)
+        self._frozen_blocks = e // self.block
+        pp.la = self._la[:cap0]
+        pp.rb = self._rb[:cap0]
+        _mark("coords", pp.la)
+
+        # 2. First descendants from the resident rank cube, folding the
+        # batch first (incremental compare-and-count — per-sync cost
+        # scales with the batch, not E; see _tables_update /
+        # _tables_update_hist, picked per backend at construction).
+        if self._e_counted < e:
+            self._ranks, self._chain_la, self._chain_rb = self._tables_fn(
+                self._ranks, self._chain_la, self._chain_rb,
+                self._la, self._rb, self._newtab_d, self._newpos_d,
+                n=n, m=self._new_m)
+            self._e_counted = e
+            self._len_counted = chain_len0.copy()
+        _mark("fd_fold", self._ranks)
+        # fd is consumed as lazy row gathers from the rank cube
+        # inside the fused kernel (_FdRows) — no [cap, n]
+        # materialization.
+
+        # 3-6. Frontier, new-event rounds, fame, and round-received in
+        # ONE device dispatch with ONE packed pull (_consensus_fused):
+        # on the tunneled runtime every device->host sync costs a full
+        # round trip, so the windows the host used to build between
+        # pulls are now derived on device from host bookkeeping tables.
+        rel_rows = len(self._fr_table)
+        if rel_rows:
+            # A row can only change when a chain it is still waiting on
+            # GROWS: frozen-row stability (module docstring) means old
+            # positions never newly strongly-see, so row t is affected
+            # only by chains c with fr[t, c] at/beyond the last-seen
+            # end AND new events this sync. Without the `grew` mask a
+            # single lagging peer marks every row past its head
+            # permanently growable, and each pass re-sweeps hundreds of
+            # rounds — a death spiral in a live testnet (slow passes ->
+            # more lag -> longer sweeps). With it, the catch-up cost is
+            # paid once, in the sync where the laggard's events arrive.
+            grew = chain_len0 > self._chain_len_prev
+            growable = (
+                (self._fr_table >= self._chain_len_prev[None, :])
+                & grew[None, :]
+            ).any(axis=1)
+            t0 = int(np.argmax(growable)) if growable.any() else rel_rows
+        else:
+            t0 = 0
+        pp.rel_rows = rel_rows
+        pp.t0 = t0
+        if t0 > 0:
+            pp.wt_prev = jnp.asarray(self._wt_table[t0 - 1])
+            pp.fr_prev = jnp.asarray(self._fr_table[t0 - 1])
+        else:
+            pp.wt_prev = jnp.full((n,), -1, jnp.int32)
+            pp.fr_prev = jnp.zeros((n,), jnp.int32)
+
+        # Batch range for device-side round assignment (contiguous ids;
+        # same coarse bucketing as _ingest_batch so live-node syncs
+        # share one compile).
+        e0_b = new_ids[0] if new_ids else e
+        b_new = e - e0_b
+        bp = _pow4(max(b_new, 1), 1024)
+        # Bound by cap (not cap+1): the kernel's rounds/rr vectors are
+        # cap long, and a clamped dynamic_update_slice would silently
+        # shift every batch round one slot down.
+        while e0_b + bp > cap0 and bp > b_new:
+            bp //= 2
+        if bp < max(b_new, 1):
+            bp = max(b_new, 1)
+        pp.e0_b = e0_b
+        pp.bp = bp
+
+        pp.undecided_set = set(self.undecided_rounds)
+        # rounds/rr live on device (committed by the previous pass);
+        # _sync_device grew them to self.cap = cap0 above.
+        pp.rounds_up = self._rounds_d
+        pp.rr_up = self._rr_d
+
+        # Undecided-event window for the round-received sweep: decided
+        # events never change, so the kernel's per-round pass compares
+        # against this compacted id set instead of all E events.
+        und = np.nonzero(self.rr[:e] < 0)[0].astype(np.int32)
+        # x4 buckets: at the n=1024 north star the undecided window
+        # grows monotonically to ~cap/2, and pow2 breathing would
+        # recompile the fused kernel at every doubling.
+        au = _pow4(len(und), 4096)
+        und_p = np.zeros(au, np.int32)
+        und_p[: len(und)] = und
+        pp.und = und
+        pp.au = au
+        pp.und_up = jnp.asarray(und_p)
+        pp.n_und = jnp.int32(len(und))
+
+        # Fame/rr window widths: the spans actually needed, not the
+        # table capacity — decide_fame costs O(rw^2) sequential steps
+        # and the rr sweep O(iw) sequential [n, E] passes, and on this
+        # runtime the per-step overhead of those loops is the dominant
+        # device cost, so every halving of the window matters. The
+        # widths are PREDICTED from the previous run's observed round
+        # growth (doubled, so steady state never redoes); the post-pull
+        # checks below are the safety net — a misprediction or a
+        # straggler batch (i0 below the known rounds) costs one redo
+        # dispatch, never correctness.
+        growth = 2 * self._last_growth + 2
+        # Empty-queue fallback: _prev_first_undec, NOT beyond the table —
+        # an empty list means either a fresh reset (first undecided round
+        # is rho_min) or a fixpoint (= r_total); in both cases rounds
+        # discovered THIS run must land inside the fame window so fame
+        # is decided in the same call, like the host's
+        # divide_rounds->decide_fame sequence.
+        rx0_known = (
+            self.undecided_rounds[0]
+            if self.undecided_rounds else self._prev_first_undec)
+        i0_known = min(self._prev_first_undec, rx0_known)
+        # ONE shared round-window size W for the fame span, the rr
+        # span, and the returned table rows: they track the same
+        # per-pass round movement, and collapsing them to a single
+        # static dimension collapses the kernel's compile space
+        # (observed live: 57 fused-kernel compiles per process with
+        # independent dims, each stalling every node's dispatches).
+        # n-scaled floors: at small n rounds arrive fast (a round
+        # per ~n events), so the windows and the round table breathe
+        # through many pow2 sizes — each a compile. The floors pin
+        # them to their realistic ceiling where that is cheap (the
+        # arrays scale with n) and stay tight at large n.
+        # Large n => few, wide rounds: the fame step is a
+        # [n, n]@[n, W*n] contraction per row, so an oversized W
+        # floor multiplies real FLOPs there; small n => fast, many
+        # rounds, where a big floor only pads cheap tiny rows but
+        # saves a compile per pow2 step.
+        w_floor = max(16, min(self._w_floor_max, (1 << 13) // n))
+        pp.w_floor = w_floor
+        pp.rw = pp.iw = _pow2(
+            max(self.rho_min + rel_rows - rx0_known,
+                self.rho_min + rel_rows - i0_known,
+                rel_rows - t0, 1) + growth, w_floor)
+        pp.rx0 = rx0_known
+        # Consensus-timestamp bucket: syncs usually receive about a
+        # batch worth of events; a late fame decision can release a
+        # backlog, detected post-pull (newly_count) and redone bigger.
+        # _last_newly keeps the bucket sticky across bursty stretches.
+        # (cb never needs to exceed the undecided window: newly-received
+        # events are a subset of it.)
+        # (no 2*b_new term: batch-size breathing must not multiply
+        # into the cb compile dimension; a burst costs one redo and
+        # then sticks via _last_newly.)
+        pp.cb = min(_pow2(max(self._last_newly, 1024)), cap0, au)
+        # Returned frontier-table rows: their own pow2 size with a
+        # large-n floor below W — at n=1024 the [tw, n] x2 planes
+        # dominate the pull, and the actually-rewritten span is a
+        # handful of rows; at small n the floor equals W's, so no
+        # extra compile combo appears where W already breathes.
+        pp.tw_floor = tw_floor = max(16, min(w_floor, (1 << 14) // n))
+        pp.tw = min(pp.rw, _pow2(
+            max(rel_rows - t0, 1) + growth, tw_floor))
+
+        # Floor 64: each distinct rcap is a static shape of the fused
+        # kernel, and on the tunneled runtime a recompile stalls a sync
+        # for seconds — a long-running node would otherwise recompile at
+        # every 16->32->64 table growth. The extra packed-pull bytes
+        # (2*rcap*n int32) are sub-millisecond even at n=1024.
+        pp.rcap = _pow2(rel_rows + 8,
+                        max(64, min(2048, (1 << 16) // n)))
+        cd0 = self.phase_ns.get("c_dispatch", 0)
+        self._dispatch_fused(pp)
+        # Worker-side share of the staging tail (window derivation +
+        # table build), excluding the dispatch-enqueue time recorded
+        # by _dispatch_fused.
+        self.phase_ns["stage"] = (
+            self.phase_ns.get("stage", 0) + _t() - _phase_start
+            - (self.phase_ns.get("c_dispatch", 0) - cd0))
+        pp.dispatched_ns = _t()
+
+    def _dispatch_fused(self, pp: PendingPass) -> None:
+        """Build the window tables from host bookkeeping and enqueue
+        the fused consensus epilogue for the pass's CURRENT window
+        sizes. Called once by dispatch() and again by collect() on a
+        window-overflow redo; reads only host state that collect alone
+        mutates, so a redo between dispatch and collect sees exactly
+        the staging-time values."""
+        import time as _time
+
+        n, sm = self.n, self.sm
+        rcap = pp.rcap
+        wt_tab = np.full((rcap, n), -1, np.int32)
+        fr_tab = np.full((rcap, n), pp.k0, np.int32)
+        wt_tab[:pp.t0] = self._wt_table[:pp.t0]
+        fr_tab[:pp.t0] = self._fr_table[:pp.t0]
+        # rho_min-relative round bookkeeping from the PREVIOUS run:
+        # fame trileans, queued state (rows beyond the known rounds
+        # default to queued — a new round is queued when its first
+        # event lands), and rr eligibility for already-decided
+        # rounds (witnesses_decided, poisoned-straggler aware).
+        fam_rel = np.zeros((rcap, n), np.int32)
+        in_list_rel = np.ones(rcap, np.bool_)
+        span = min(pp.rel_rows, rcap)
+        for t in range(span):
+            rho = self.rho_min + t
+            fam_rel[t] = self.famous[rho]
+            in_list_rel[t] = rho in pp.undecided_set
+        # Clamp into pass-locals so an rcap-doubling redo reclamps
+        # from the intact prediction instead of a stale bound.
+        pp.tw_i = min(pp.tw, rcap)
+        pp.t_start = min(pp.t0, rcap - pp.tw_i)
+        _t_stage = _time.perf_counter_ns()
+        pp.packed_dev, pp.rounds_out, pp.rr_out = _consensus_fused(
+            self._chain_la, self._chain_rb, pp.chain_len_d, pp.la,
+            self._ranks, pp.rb,
+            self._chain_d, jnp.asarray(wt_tab), jnp.asarray(fr_tab),
+            pp.wt_prev, pp.fr_prev, jnp.int32(pp.t0),
+            jnp.int32(self.rho_min),
+            self._sp_d, pp.cr_d, pp.idx_d, pp.coin_d,
+            jnp.int32(pp.e0_b), jnp.int32(pp.e), pp.rounds_up, pp.rr_up,
+            jnp.asarray(fam_rel), jnp.asarray(in_list_rel),
+            self._chain_th, self._chain_tl, jnp.int32(pp.rx0),
+            jnp.int32(self._prev_first_undec), pp.und_up, pp.n_und,
+            jnp.int32(pp.t_start),
+            n=n, sm=sm, rcap=rcap, bp=pp.bp, rw=pp.rw, iw=pp.iw,
+            cb=pp.cb, tw=pp.tw_i)
+        self.phase_ns["c_dispatch"] = (
+            self.phase_ns.get("c_dispatch", 0)
+            + _time.perf_counter_ns() - _t_stage)
+
+    def _collect_pass(self, pp: PendingPass, unlocked) -> RunDelta:
+        n = self.n
+        import time as _time
+
+        _t = _time.perf_counter_ns
+        # The stage-wait + pull + redo loop runs with the caller's
+        # lock RELEASED (the one blocking device->host wait of the
+        # pass): every input was uploaded at dispatch, and everything
+        # below uses the pass snapshot, so interleaved appends are
+        # safe (see run() docstring).
         _uctx = unlocked() if unlocked is not None else None
         if _uctx is not None:
             _uctx.__enter__()
         try:
-            # 0. Device sync-up: lazy capacity growth, then ingest the new
-            # batch into the resident event arrays and chain table. All
-            # dispatches are async — nothing here round-trips. Under a mesh,
-            # re-pin the carries first (growth concats and kernel outputs
-            # may drift from the intended shardings).
-            self._sync_device(cap0, k0)
-            self._constrain_carries()
-            self._ingest_batch(e, chain_len0)
-            chain_len_d = jnp.asarray(chain_len0)
-            cr_d = self._cr_d
-            idx_d = self._idx_d
-            coin_d = self._coin_d
-
-            # 1. Coordinates: only blocks the frozen prefix doesn't cover.
-            nb = (e + self.block - 1) // self.block
-            self._la, self._rb = _closure_update(
-                self._la, self._rb, self._sp_d, self._op_d, cr_d, idx_d,
-                self._rb0_d, jnp.int32(self._frozen_blocks), jnp.int32(nb),
-                n=n, block=self.block)
-            self._frozen_blocks = e // self.block
-            la = self._la[:cap0]
-            rb = self._rb[:cap0]
-            _mark("coords", la)
-
-            # 2. First descendants from the resident rank cube, folding the
-            # batch first (incremental compare-and-count — per-sync cost
-            # scales with the batch, not E; see _tables_update).
-            if self._e_counted < e:
-                self._ranks, self._chain_la, self._chain_rb = _tables_update(
-                    self._ranks, self._chain_la, self._chain_rb,
-                    self._la, self._rb, self._newtab_d, self._newpos_d,
-                    n=n, m=self._new_m)
-                self._e_counted = e
-                self._len_counted = chain_len0.copy()
-            _mark("fd_fold", self._ranks)
-            # fd is consumed as lazy row gathers from the rank cube
-            # inside the fused kernel (_FdRows) — no [cap, n]
-            # materialization.
-
-            # 3-6. Frontier, new-event rounds, fame, and round-received in
-            # ONE device dispatch with ONE packed pull (_consensus_fused):
-            # on the tunneled runtime every device->host sync costs a full
-            # round trip, so the windows the host used to build between
-            # pulls are now derived on device from host bookkeeping tables.
-            rel_rows = len(self._fr_table)
-            if rel_rows:
-                # A row can only change when a chain it is still waiting on
-                # GROWS: frozen-row stability (module docstring) means old
-                # positions never newly strongly-see, so row t is affected
-                # only by chains c with fr[t, c] at/beyond the last-seen
-                # end AND new events this sync. Without the `grew` mask a
-                # single lagging peer marks every row past its head
-                # permanently growable, and each pass re-sweeps hundreds of
-                # rounds — a death spiral in a live testnet (slow passes ->
-                # more lag -> longer sweeps). With it, the catch-up cost is
-                # paid once, in the sync where the laggard's events arrive.
-                grew = chain_len0 > self._chain_len_prev
-                growable = (
-                    (self._fr_table >= self._chain_len_prev[None, :])
-                    & grew[None, :]
-                ).any(axis=1)
-                t0 = int(np.argmax(growable)) if growable.any() else rel_rows
-            else:
-                t0 = 0
-            if t0 > 0:
-                wt_prev = jnp.asarray(self._wt_table[t0 - 1])
-                fr_prev = jnp.asarray(self._fr_table[t0 - 1])
-            else:
-                wt_prev = jnp.full((n,), -1, jnp.int32)
-                fr_prev = jnp.zeros((n,), jnp.int32)
-
-            # Batch range for device-side round assignment (contiguous ids;
-            # same coarse bucketing as _ingest_batch so live-node syncs
-            # share one compile).
-            e0_b = new_ids[0] if new_ids else e
-            b_new = e - e0_b
-            bp = _pow4(max(b_new, 1), 1024)
-            # Bound by cap (not cap+1): the kernel's rounds/rr vectors are
-            # cap long, and a clamped dynamic_update_slice would silently
-            # shift every batch round one slot down.
-            while e0_b + bp > cap0 and bp > b_new:
-                bp //= 2
-            if bp < max(b_new, 1):
-                bp = max(b_new, 1)
-
-            undecided_set = set(self.undecided_rounds)
-            # rounds/rr live on device (committed by the previous pass);
-            # _sync_device grew them to self.cap = cap0 above.
-            rounds_up = self._rounds_d
-            rr_up = self._rr_d
-
-            # Undecided-event window for the round-received sweep: decided
-            # events never change, so the kernel's per-round pass compares
-            # against this compacted id set instead of all E events.
-            und = np.nonzero(self.rr[:e] < 0)[0].astype(np.int32)
-            # x4 buckets: at the n=1024 north star the undecided window
-            # grows monotonically to ~cap/2, and pow2 breathing would
-            # recompile the fused kernel at every doubling.
-            au = _pow4(len(und), 4096)
-            und_p = np.zeros(au, np.int32)
-            und_p[: len(und)] = und
-            und_up = jnp.asarray(und_p)
-            n_und = jnp.int32(len(und))
-
-            # Fame/rr window widths: the spans actually needed, not the
-            # table capacity — decide_fame costs O(rw^2) sequential steps
-            # and the rr sweep O(iw) sequential [n, E] passes, and on this
-            # runtime the per-step overhead of those loops is the dominant
-            # device cost, so every halving of the window matters. The
-            # widths are PREDICTED from the previous run's observed round
-            # growth (doubled, so steady state never redoes); the post-pull
-            # checks below are the safety net — a misprediction or a
-            # straggler batch (i0 below the known rounds) costs one redo
-            # dispatch, never correctness.
-            growth = 2 * self._last_growth + 2
-            # Empty-queue fallback: _prev_first_undec, NOT beyond the table —
-            # an empty list means either a fresh reset (first undecided round
-            # is rho_min) or a fixpoint (= r_total); in both cases rounds
-            # discovered THIS run must land inside the fame window so fame
-            # is decided in the same call, like the host's
-            # divide_rounds->decide_fame sequence.
-            rx0_known = (
-                self.undecided_rounds[0]
-                if self.undecided_rounds else self._prev_first_undec)
-            i0_known = min(self._prev_first_undec, rx0_known)
-            # ONE shared round-window size W for the fame span, the rr
-            # span, and the returned table rows: they track the same
-            # per-pass round movement, and collapsing them to a single
-            # static dimension collapses the kernel's compile space
-            # (observed live: 57 fused-kernel compiles per process with
-            # independent dims, each stalling every node's dispatches).
-            # n-scaled floors: at small n rounds arrive fast (a round
-            # per ~n events), so the windows and the round table breathe
-            # through many pow2 sizes — each a compile. The floors pin
-            # them to their realistic ceiling where that is cheap (the
-            # arrays scale with n) and stay tight at large n.
-            # Large n => few, wide rounds: the fame step is a
-            # [n, n]@[n, W*n] contraction per row, so an oversized W
-            # floor multiplies real FLOPs there; small n => fast, many
-            # rounds, where a big floor only pads cheap tiny rows but
-            # saves a compile per pow2 step.
-            w_floor = max(16, min(256, (1 << 13) // n))
-            rw = iw = _pow2(
-                max(self.rho_min + rel_rows - rx0_known,
-                    self.rho_min + rel_rows - i0_known,
-                    rel_rows - t0, 1) + growth, w_floor)
-            # Consensus-timestamp bucket: syncs usually receive about a
-            # batch worth of events; a late fame decision can release a
-            # backlog, detected post-pull (newly_count) and redone bigger.
-            # _last_newly keeps the bucket sticky across bursty stretches.
-            # (cb never needs to exceed the undecided window: newly-received
-            # events are a subset of it.)
-            # (no 2*b_new term: batch-size breathing must not multiply
-            # into the cb compile dimension; a burst costs one redo and
-            # then sticks via _last_newly.)
-            cb = min(_pow2(max(self._last_newly, 1024)), cap0, au)
-            # Returned frontier-table rows: their own pow2 size with a
-            # large-n floor below W — at n=1024 the [tw, n] x2 planes
-            # dominate the pull, and the actually-rewritten span is a
-            # handful of rows; at small n the floor equals W's, so no
-            # extra compile combo appears where W already breathes.
-            tw_floor = max(16, min(w_floor, (1 << 14) // n))
-            tw = min(rw, _pow2(
-                max(rel_rows - t0, 1) + growth, tw_floor))
-
-            # Floor 64: each distinct rcap is a static shape of the fused
-            # kernel, and on the tunneled runtime a recompile stalls a sync
-            # for seconds — a long-running node would otherwise recompile at
-            # every 16->32->64 table growth. The extra packed-pull bytes
-            # (2*rcap*n int32) are sub-millisecond even at n=1024.
-            rcap = _pow2(rel_rows + 8, max(64, min(2048, (1 << 16) // n)))
+            # Wait for the staging worker (usually already done — the
+            # wait is only non-zero when collect fires before staging
+            # could enqueue everything, e.g. under compile stalls).
+            # phase_ns keys must not be written before this point:
+            # _stage_pass resets the dict on the worker.
+            _t_wait = _t()
+            pp.ready.wait()
+            if pp.error is not None:
+                raise pp.error
+            t_enter = _t()
+            self.phase_ns["c_stage_wait"] = (
+                self.phase_ns.get("c_stage_wait", 0) + t_enter - _t_wait)
+            # Overlap diagnostic: wall between the staging worker's
+            # last enqueue and collect entry — device compute the host
+            # did NOT wait for (it was ingesting gossip instead).
+            self.last_overlap_ns = max(t_enter - pp.dispatched_ns, 0)
+            cd0 = self.phase_ns.get("c_dispatch", 0)
+            cp0 = self.phase_ns.get("c_pull", 0)
             while True:
-                wt_tab = np.full((rcap, n), -1, np.int32)
-                fr_tab = np.full((rcap, n), k0, np.int32)
-                wt_tab[:t0] = self._wt_table[:t0]
-                fr_tab[:t0] = self._fr_table[:t0]
-                # rho_min-relative round bookkeeping from the PREVIOUS run:
-                # fame trileans, queued state (rows beyond the known rounds
-                # default to queued — a new round is queued when its first
-                # event lands), and rr eligibility for already-decided
-                # rounds (witnesses_decided, poisoned-straggler aware).
-                fam_rel = np.zeros((rcap, n), np.int32)
-                in_list_rel = np.ones(rcap, np.bool_)
-                span = min(rel_rows, rcap)
-                for t in range(span):
-                    rho = self.rho_min + t
-                    fam_rel[t] = self.famous[rho]
-                    in_list_rel[t] = rho in undecided_set
-                rx0 = rx0_known
-                # Clamp into a loop-local so an rcap-doubling redo reclamps
-                # from the intact prediction instead of a stale bound.
-                tw_i = min(tw, rcap)
-                t_start = min(t0, rcap - tw_i)
-                _t_stage = _t()
-                packed_dev, rounds_out, rr_out = _consensus_fused(
-                    self._chain_la, self._chain_rb, chain_len_d, la,
-                    self._ranks, rb,
-                    self._chain_d, jnp.asarray(wt_tab), jnp.asarray(fr_tab),
-                    wt_prev, fr_prev, jnp.int32(t0), jnp.int32(self.rho_min),
-                    self._sp_d, cr_d, idx_d, coin_d,
-                    jnp.int32(e0_b), jnp.int32(e), rounds_up, rr_up,
-                    jnp.asarray(fam_rel), jnp.asarray(in_list_rel),
-                    self._chain_th, self._chain_tl, jnp.int32(rx0),
-                    jnp.int32(self._prev_first_undec), und_up, n_und,
-                    jnp.int32(t_start),
-                    n=n, sm=sm, rcap=rcap, bp=bp, rw=rw, iw=iw, cb=cb,
-                    tw=tw_i)
-                # The one blocking device->host wait of the pass. With an
-                # `unlocked` seam, the caller's lock is released here —
-                # every input above was uploaded already, and everything
-                # below uses the run's snapshot, so interleaved appends
-                # are safe (see docstring).
-                self.phase_ns["c_dispatch"] = (
-                    self.phase_ns.get("c_dispatch", 0) + _t() - _t_stage)
                 _t_pull = _t()
-                packed = np.asarray(packed_dev)
+                packed = np.asarray(pp.packed_dev)
                 self.phase_ns["c_pull"] = (
                     self.phase_ns.get("c_pull", 0) + _t() - _t_pull)
                 t_end = int(packed[0])
                 newly_count = int(packed[1])
-                if t_end == rcap:
+                if t_end == pp.rcap:
                     # Frontier overflow: the fame/rr results were computed
                     # against a truncated table. They are a safe subset
                     # (eligibility is gated by the first undecided round, so
                     # no wrong or out-of-order assignment is possible) but
                     # incomplete — discard and redo at double capacity.
-                    rcap *= 2
+                    pp.rcap *= 2
                     self.redo_count += 1
+                    self._dispatch_fused(pp)
                     continue
                 # Window overflow: in-window results are a valid subset
                 # (decisions are monotone in voting rounds; rr assignments
@@ -1208,37 +1497,50 @@ class IncrementalEngine:
                 # the tw_i actually dispatched), so a sync overflowing
                 # several windows enlarges them all before ONE redo.
                 redo = False
-                if t_end > t_start + tw_i:
+                if t_end > pp.t_start + pp.tw_i:
                     # Returned-window overflow: the sweep advanced past the
                     # predicted row window — redo with the exact span.
-                    tw = _pow2(max(t_end - t_start, tw_i + 1), tw_floor)
-                    rw = iw = max(rw, _pow2(tw, w_floor))
+                    pp.tw = _pow2(max(t_end - pp.t_start, pp.tw_i + 1),
+                                  pp.tw_floor)
+                    pp.rw = pp.iw = max(pp.rw, _pow2(pp.tw, pp.w_floor))
                     redo = True
-                rnd_b = packed[2 + 2 * tw_i * n:2 + 2 * tw_i * n + bp]
+                rnd_b = packed[2 + 2 * pp.tw_i * n:
+                               2 + 2 * pp.tw_i * n + pp.bp]
                 valid_b = rnd_b >= 0
                 min_new = int(rnd_b[valid_b].min()) if valid_b.any() else None
                 r_hi = self.rho_min + t_end
                 i0_true = self._prev_first_undec
                 if min_new is not None:
                     i0_true = min(i0_true, min_new + 1)
-                if (r_hi - rx0 > rw or r_hi - i0_true > iw
-                        or newly_count > cb):
-                    rw = iw = _pow2(
-                        max(r_hi - rx0, r_hi - i0_true, rw), w_floor)
-                    cb = min(_pow2(max(newly_count, 1024)), cap0, au)
+                if (r_hi - pp.rx0 > pp.rw or r_hi - i0_true > pp.iw
+                        or newly_count > pp.cb):
+                    pp.rw = pp.iw = _pow2(
+                        max(r_hi - pp.rx0, r_hi - i0_true, pp.rw),
+                        pp.w_floor)
+                    pp.cb = min(_pow2(max(newly_count, 1024)), pp.cap0,
+                                pp.au)
                     redo = True
                 if redo:
                     self.redo_count += 1
+                    self._dispatch_fused(pp)
                     continue
                 # Window-geometry diagnostics of the final dispatch.
                 self._dbg_windows = dict(
-                    rcap=rcap, rw=rw, iw=iw, cb=cb, au=au, bp=bp,
-                    tw=tw_i, t0=t0, t_end=t_end, rel_rows=rel_rows)
+                    rcap=pp.rcap, rw=pp.rw, iw=pp.iw, cb=pp.cb, au=pp.au,
+                    bp=pp.bp, tw=pp.tw_i, t0=pp.t0, t_end=t_end,
+                    rel_rows=pp.rel_rows)
                 break
         finally:
             if _uctx is not None:
                 _uctx.__exit__(None, None, None)
 
+        e = pp.e
+        cap0 = pp.cap0
+        chain_len0 = pp.chain_len0
+        new_ids = pp.new_ids
+        tw_i, t_start, bp, rw, cb = pp.tw_i, pp.t_start, pp.bp, pp.rw, pp.cb
+        rel_rows, rx0, und = pp.rel_rows, pp.rx0, pp.und
+        rounds_out, rr_out = pp.rounds_out, pp.rr_out
         off = 2
         tabs = packed[off:off + 2 * tw_i * n].reshape(2, tw_i, n)
         off += 2 * tw_i * n
@@ -1266,10 +1568,9 @@ class IncrementalEngine:
         # double-counted and skew the bench's bounded-by verdict).
         _now = _t()
         self.phase_ns["consensus"] = (
-            _now - _phase_start
-            - self.phase_ns.get("c_dispatch", 0)
-            - self.phase_ns.get("c_pull", 0))
-        _phase_start = _now
+            self.phase_ns.get("consensus", 0) + _now - t_enter
+            - (self.phase_ns.get("c_dispatch", 0) - cd0)
+            - (self.phase_ns.get("c_pull", 0) - cp0))
 
         active = (fr_all < chain_len0[None, :]).any(axis=1)
         n_rows = int(np.nonzero(active)[0][-1]) + 1 if active.any() else 0
@@ -1355,10 +1656,74 @@ class IncrementalEngine:
         self._rounds_d = rounds_out
         self._rr_d = rr_out
 
+        # Host mirror application time — the remaining post-pull share
+        # of the pass (everything above `_now`).
+        self.phase_ns["apply"] = (
+            self.phase_ns.get("apply", 0) + _t() - _now)
+
         # An append that slipped in during the unlocked wait means the
         # state is NOT at a fixpoint yet.
         self._empty_delta_ok = not self._new_since_run
         return delta
+
+    # -- compile prewarm ----------------------------------------------------
+
+    def prewarm(self, *, budget_bytes: int = 1 << 28) -> bool:
+        """Compile the cold-start kernel ladder before live traffic.
+
+        Builds a scratch sibling engine with the SAME static shapes
+        (jit caches are process-global and shape-keyed), feeds it a
+        small synthetic gossip DAG, and runs two passes — exactly the
+        compiles a fresh live engine pays over its first syncs (ingest
+        and fused-epilogue batch buckets are floor-padded, so any
+        batch <= the floor shares these), moved to construction time.
+        With a persistent compile cache (devices.ensure_compile_cache)
+        the XLA artifacts also survive restarts, so a rebooted node
+        skips even these. This is what retires the multi-thousand-event
+        warm gate live nodes used to need before reaching steady state.
+
+        Returns False (skipped) when the scratch carries would exceed
+        `budget_bytes` — at large n the transient doubling of resident
+        table memory is not worth it; those deployments rely on the
+        persistent cache instead. Idempotent per shape-key per process.
+        """
+        key = (self.n, self.cap, self.kcap, self.block,
+               id(self._mesh) if self._mesh is not None else None)
+        if key in _PREWARM_DONE:
+            return True
+        n = self.n
+        est = 4 * ((self.cap + 1) * n            # la
+                   + 2 * n * n * self.kcap       # ranks + chain_la
+                   + 5 * n * self.kcap           # chain id/ts/rb tables
+                   + 8 * self.cap)               # 1-D event vectors
+        if est > budget_bytes:
+            return False
+        scratch = IncrementalEngine(
+            n, capacity=self.cap, block=self.block, k_capacity=self.kcap,
+            mesh=self._mesh, mesh_axis=self._mesh_axis)
+        heads = [-1] * n
+        idx = [0] * n
+        ts = 1_700_000_000_000_000_000
+
+        def gossip_round(step: int) -> None:
+            nonlocal ts
+            for c in range(n):
+                op = heads[(c + step) % n] if heads[c] >= 0 else -1
+                ts += 1_000_000
+                eid = scratch.append(
+                    heads[c], op, c, idx[c], (idx[c] + c) % 2 == 1, ts)
+                heads[c] = eid
+                idx[c] += 1
+
+        for step in (1, 2):
+            gossip_round(step)
+        scratch.run()
+        for step in (3, 1):
+            gossip_round(step)
+        scratch.run()
+        scratch.close()
+        _PREWARM_DONE.add(key)
+        return True
 
     # -- queries -----------------------------------------------------------
 

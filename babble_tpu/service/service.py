@@ -2,7 +2,13 @@
 — reference service/service.go:17-65 — plus GET /debug/profile, the
 live-profiling counterpart of the reference's pprof mount
 (reference cmd/babble/main.go:12) re-targeted at the device: it
-captures a JAX profiler trace of the running node for N seconds."""
+captures a JAX profiler trace of the running node for N seconds.
+
+GET /debug/phases serves the overlap-aware per-phase timers as
+structured numbers: for each phase the last/total/calls triple from
+Core.phase_ns, plus the engine's pipeline diagnostics (host-blocking
+pull share vs the device compute that overlapped gossip ingest) — the
+attribution view for "what bounds this node's consensus rate"."""
 
 from __future__ import annotations
 
@@ -51,6 +57,31 @@ class Service:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif url.path.rstrip("/") == "/debug/phases":
+                    core = service.node.core
+                    phases = {
+                        ph: {"last_ns": ent[0], "total_ns": ent[1],
+                             "calls": ent[2]}
+                        for ph, ent in list(core.phase_ns.items())
+                    }
+                    out = {"phases": phases}
+                    engine = getattr(core.hg, "engine", None)
+                    if engine is not None:
+                        # Host-blocking vs overlapped device time of the
+                        # async pipeline (see ops/incremental.py):
+                        # c_pull is what the host actually waited at
+                        # delta-fetch; overlap is device compute that
+                        # ran while the host ingested gossip.
+                        out["engine"] = {
+                            "backlog": engine.backlog(),
+                            "inflight": engine.inflight,
+                            "redo_count": engine.redo_count,
+                            "last_overlap_ns": engine.last_overlap_ns,
+                            "last_pass_phase_ns": dict(engine.phase_ns),
+                            "windows": getattr(engine, "_dbg_windows",
+                                               None),
+                        }
+                    self._json(200, out)
                 elif url.path.rstrip("/") == "/debug/profile":
                     # Like the reference's pprof mount, this is an
                     # operator tool: bind service_addr to localhost in

@@ -310,10 +310,12 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
                                 warm_gate_events=1500, windows=1):
     """Throughput of a live localhost testnet: N real nodes (threads,
     inmem transport, signed events, full sync protocol) bombarded with
-    transactions; returns committed consensus events/sec during a
-    steady-state window after a warmup (compiles + cache fill). The
-    reference's counterpart is the 4-node docker demo steady state
-    (reference docs/usage.rst:31-34)."""
+    transactions; returns (committed consensus events/sec during a
+    steady-state window after a warmup, per-phase breakdown dict) —
+    the breakdown aggregates every node's Core.phase_ns so a
+    regression in this stage is attributable to a phase (the sustained
+    stage alone had this before). The reference's counterpart is the
+    4-node docker demo steady state (reference docs/usage.rst:31-34)."""
     import threading
 
     import jax as _jax
@@ -351,6 +353,10 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
     for i, (key, peer) in enumerate(entries):
         conf = test_config(heartbeat=0.01, cache_size=100000)
         conf.engine = engine
+        # Compile the engine's kernel ladder at construction (first
+        # node pays; jit caches are process-global) — this is what
+        # retired the old 6000-event warm gate.
+        conf.engine_prewarm = engine == "tpu"
         # Batch many syncs per consensus pass. For the tpu engine each
         # pass costs a ~110 ms tunnel round trip and the nodes share
         # one chip, so a 1 s cadence keeps the tunnel under 50% duty
@@ -417,6 +423,35 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
             # c1 <= c0: a lagging node fast-forwarded (store reset,
             # node.py _fast_forward) or the chip stalled — skip the
             # window.
+        # Per-phase breakdown (harvested before shutdown): node-level
+        # phases and, for the device engine, its sub-phases. The
+        # engine_* entries are subsets of consensus_dispatch/collect
+        # wall, so they get their own share denominator; engine_overlap
+        # is not host wall at all (device compute that overlapped
+        # ingest) and rides along in seconds.
+        tot: dict = {}
+        for nd in nodes:
+            for ph, ent in list(nd.core.phase_ns.items()):
+                tot[ph] = tot.get(ph, 0) + ent[1]
+        phases: dict = {}
+        top = {ph: v for ph, v in tot.items()
+               if not ph.startswith("engine_")}
+        if top:
+            s = sum(top.values())
+            phases["phase_share"] = {
+                ph: round(v / s, 3) for ph, v in sorted(top.items())}
+        eng_t = {ph[len("engine_"):]: v for ph, v in tot.items()
+                 if ph.startswith("engine_") and ph != "engine_overlap"}
+        if eng_t:
+            es = sum(eng_t.values())
+            phases["engine_phase_share"] = {
+                ph: round(v / es, 3) for ph, v in sorted(eng_t.items())}
+            phases["engine_pull_share"] = round(
+                (eng_t.get("c_pull", 0) + eng_t.get("coords", 0)
+                 + eng_t.get("fd_fold", 0)) / es, 3)
+        if "engine_overlap" in tot:
+            phases["engine_overlap_s"] = round(
+                tot["engine_overlap"] / 1e9, 2)
     finally:
         _sys.setswitchinterval(old_switch)
         stop.set()
@@ -431,8 +466,8 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
     # true median: even counts average the middle pair (an
     # upper-middle pick would report the best window after a skip).
     if m % 2:
-        return rates[m // 2]
-    return (rates[m // 2 - 1] + rates[m // 2]) / 2.0
+        return rates[m // 2], phases
+    return (rates[m // 2 - 1] + rates[m // 2]) / 2.0, phases
 
 
 def child():
@@ -539,25 +574,56 @@ def child():
         from babble_tpu.ops.incremental import IncrementalEngine
 
         n, e_sus, bs = 64, 50_000, 4096
-        log(f"stage sustained: n={n} e={e_sus} batch={bs}")
+        log(f"stage sustained: n={n} e={e_sus} batch={bs} (pipelined)")
         dag_s, _ = synthetic_dag(n, e_sus, seed=3)
         eng = IncrementalEngine(
             n, capacity=65536, block=512, k_capacity=1024)
         import numpy as _np
 
+        # PIPELINED driving — the same dispatch/collect overlap the
+        # live node's consensus worker uses: append batch k+1 while
+        # pass k computes on device, then collect k's commit delta and
+        # dispatch k+1. Per-batch time is the HOST-BLOCKING wall
+        # (append + collect wait + dispatch staging); the device
+        # compute that overlapped the append no longer counts, which
+        # is exactly the production hot path.
+        phase_tot: dict = {}
+        overlap_ns = 0
+        prof_from = 3  # skip compile-warmup batches in the phase split
+
+        def _harvest(b_i):
+            nonlocal overlap_ns
+            if b_i >= prof_from:
+                for ph, ns in eng.phase_ns.items():
+                    phase_tot[ph] = phase_tot.get(ph, 0) + ns
+                overlap_ns += eng.last_overlap_ns
+
         t0 = time.perf_counter()
         per_batch = []
+        pending = None
+        b_i = 0
         k = 0
         while k < e_sus:
             hi = min(k + bs, e_sus)
+            tb = time.perf_counter()
             eng.append_batch(
                 dag_s.self_parent[k:hi], dag_s.other_parent[k:hi],
                 dag_s.creator[k:hi], dag_s.index[k:hi], dag_s.coin[k:hi],
                 _np.arange(k, hi))
-            tb = time.perf_counter()
-            eng.run()
+            if pending is not None:
+                eng.collect(pending)
+                _harvest(b_i)
+            pending = eng.dispatch()
             per_batch.append(time.perf_counter() - tb)
+            b_i += 1
             k = hi
+        if pending is not None:
+            eng.collect(pending)
+            _harvest(b_i)
+        # Drain appends staged during the final in-flight pass.
+        pending = eng.dispatch()
+        if pending is not None:
+            eng.collect(pending)
         total = time.perf_counter() - t0
         if e_sus % bs:  # final partial batch would skew the per-batch rate
             per_batch = per_batch[:-1]
@@ -573,39 +639,56 @@ def child():
             round(min(half), 3), round(max(half), 3)]
         payload["sustained_batch"] = bs
 
-        # Phase split in a SEPARATE short pass (synced per-phase timers
-        # perturb async dispatch, so they must not run inside the timed
-        # loop): a fresh engine replays the first 6 batches with the
-        # main loop's compile caches warm, and the shares come from the
-        # post-warmup batches — answering WHICH stage bounds the
-        # sustained rate (coords / fd / the fused consensus tail).
+        # Phase split of the pipelined loop: host-blocking ns per
+        # phase (timers NOT synced — async dispatches only charge
+        # their enqueue). The device->host trio (c_pull + coords +
+        # fd_fold) is the share the tentpole targets: with the delta
+        # pull overlapped it should be a small minority of pass wall.
+        if phase_tot:
+            tot_ns = sum(phase_tot.values())
+            shares = {ph: round(ns / tot_ns, 3)
+                      for ph, ns in sorted(phase_tot.items())}
+            bounding = max(phase_tot, key=phase_tot.get)
+            pull_share = (shares.get("c_pull", 0) + shares.get("coords", 0)
+                          + shares.get("fd_fold", 0))
+            log(f"  phase split: " + ", ".join(
+                f"{ph} {sh:.0%}" for ph, sh in shares.items())
+                + f" -> bounded by {bounding}; "
+                f"pull share {pull_share:.0%}, "
+                f"overlap {overlap_ns / 1e9:.1f}s")
+            payload["sustained_phase_share"] = shares
+            payload["sustained_bounding_phase"] = bounding
+            payload["sustained_pull_share"] = round(pull_share, 3)
+            payload["sustained_overlap_s"] = round(overlap_ns / 1e9, 2)
+
+        # Device-time attribution in a SEPARATE short pass (synced
+        # per-phase timers serialize every stage, so they must not run
+        # inside the timed loop): a fresh engine replays the first 6
+        # batches synchronously with compile caches warm — answering
+        # which DEVICE stage is the biggest compute, independent of
+        # what the pipeline hides from the host.
         prof = IncrementalEngine(n, capacity=65536, block=512,
                                  k_capacity=1024)
         os.environ["BABBLE_ENGINE_TIMERS"] = "1"
-        phase_tot: dict = {}
+        phase_sync: dict = {}
         k = 0
-        for b_i in range(min(6, len(per_batch))):
+        for p_i in range(min(6, len(per_batch))):
             hi = min(k + bs, e_sus)
             prof.append_batch(
                 dag_s.self_parent[k:hi], dag_s.other_parent[k:hi],
                 dag_s.creator[k:hi], dag_s.index[k:hi], dag_s.coin[k:hi],
                 _np.arange(k, hi))
             prof.run()
-            if b_i >= 3:  # skip warmup batches
+            if p_i >= 3:  # skip warmup batches
                 for ph, ns in prof.phase_ns.items():
-                    phase_tot[ph] = phase_tot.get(ph, 0) + ns
+                    phase_sync[ph] = phase_sync.get(ph, 0) + ns
             k = hi
         os.environ.pop("BABBLE_ENGINE_TIMERS", None)
-        if phase_tot:
-            tot_ns = sum(phase_tot.values())
-            shares = {ph: round(ns / tot_ns, 3)
-                      for ph, ns in sorted(phase_tot.items())}
-            bounding = max(phase_tot, key=phase_tot.get)
-            log(f"  phase split: " + ", ".join(
-                f"{ph} {sh:.0%}" for ph, sh in shares.items())
-                + f" -> bounded by {bounding}")
-            payload["sustained_phase_share"] = shares
-            payload["sustained_bounding_phase"] = bounding
+        if phase_sync:
+            tot_ns = sum(phase_sync.values())
+            payload["sustained_phase_share_synced"] = {
+                ph: round(ns / tot_ns, 3)
+                for ph, ns in sorted(phase_sync.items())}
         _emit(payload)
 
     on_cpu = jax.default_backend() == "cpu"
@@ -623,28 +706,39 @@ def child():
     if os.environ.get("BENCH_SKIP_NODE") != "1":
         if _budget_left() > 180:
             try:
-                node_eps = node_testnet_events_per_sec(
+                node_eps, node_ph = node_testnet_events_per_sec(
                     engine="host", warm_s=30.0, window_s=30.0)
                 log(f"  4-node --engine host testnet: {node_eps:,.1f} "
                     f"committed events/s (ref docker: {ref_docker})")
                 payload["node_events_per_s"] = round(node_eps, 1)
                 payload["node_vs_ref_docker"] = round(
                     node_eps / ref_docker, 2)
+                payload["node_phase_share"] = node_ph.get("phase_share")
                 _emit(payload)
             except Exception as exc:  # noqa: BLE001
                 log(f"  node host stage failed: {exc}")
         if _budget_left() > 520 and not on_cpu:
             try:
-                # Generous gate: the engine's window shapes keep
-                # drifting (compiling) for the first few thousand
-                # committed events; measuring earlier catches compile
-                # stalls in the window (A/B: 285 vs 480+ ev/s).
-                node_eps = node_testnet_events_per_sec(
-                    engine="tpu", warm_s=300.0, window_s=40.0,
-                    warm_gate_events=6000, windows=3)
+                # The warm gate shrank 6000 -> 2500 committed events:
+                # engine prewarm compiles the kernel ladder at node
+                # construction and the persistent compile cache covers
+                # restarts, so the old multi-thousand-event drift of
+                # window-shape compiles is mostly gone.
+                node_eps, node_ph = node_testnet_events_per_sec(
+                    engine="tpu", warm_s=180.0, window_s=40.0,
+                    warm_gate_events=2500, windows=3)
                 log(f"  4-node --engine tpu testnet (one shared chip): "
-                    f"{node_eps:,.1f} committed events/s")
+                    f"{node_eps:,.1f} committed events/s; "
+                    f"phases {node_ph}")
                 payload["node_tpu_events_per_s"] = round(node_eps, 1)
+                payload["node_tpu_phase_share"] = node_ph.get(
+                    "phase_share")
+                payload["node_tpu_engine_phase_share"] = node_ph.get(
+                    "engine_phase_share")
+                payload["node_tpu_engine_pull_share"] = node_ph.get(
+                    "engine_pull_share")
+                payload["node_tpu_engine_overlap_s"] = node_ph.get(
+                    "engine_overlap_s")
                 _emit(payload)
             except Exception as exc:  # noqa: BLE001
                 log(f"  node tpu stage failed: {exc}")
@@ -652,7 +746,7 @@ def child():
         # deployment size, host engine (16 independent engines).
         if _budget_left() > 150:
             try:
-                node_eps = node_testnet_events_per_sec(
+                node_eps, _ = node_testnet_events_per_sec(
                     engine="host", n_nodes=16, warm_s=45.0, window_s=30.0,
                     interval=1.0)
                 log(f"  16-node --engine host testnet: {node_eps:,.1f} "

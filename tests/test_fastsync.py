@@ -179,20 +179,28 @@ def test_node_fast_sync_catches_up():
         assert late.fast_forwards >= 1, "late node never fast-forwarded"
         lc = late.core.get_consensus_events()
         assert lc, "late node reached no consensus after fast-forward"
-        ref = nodes[0].core.get_consensus_events()
-        # wait until node0 has at least caught the start of late's list
-        deadline = time.monotonic() + 60.0
-        while time.monotonic() < deadline and lc[0] not in ref:
-            time.sleep(0.25)
-            ref = nodes[0].core.get_consensus_events()
         # Skip the frame-boundary region (see the core-level test):
         # compare from the first event BOTH lists contain, two rounds
-        # past the late node's first received round.
-        lrr = [late.core.get_event(h).round_received for h in lc]
-        base = min(r for r in lrr if r is not None)
-        lc_f = [h for h, r in zip(lc, lrr) if r is not None and r > base + 2]
-        ref_set = set(ref)
-        lc_f = [h for h in lc_f if h in ref_set]
+        # past the late node's first received round. Right after the
+        # fast-forward the late node may only have boundary-region
+        # commits, so refresh both lists until comparable post-boundary
+        # consensus exists.
+        deadline = time.monotonic() + 60.0
+        lc_f: list = []
+        ref: list = []
+        while time.monotonic() < deadline and not lc_f:
+            time.sleep(0.25)
+            lc = late.core.get_consensus_events()
+            ref = nodes[0].core.get_consensus_events()
+            lrr = [late.core.get_event(h).round_received for h in lc]
+            known = [r for r in lrr if r is not None]
+            if not known:
+                continue
+            base = min(known)
+            lc_f = [h for h, r in zip(lc, lrr)
+                    if r is not None and r > base + 2]
+            ref_set = set(ref)
+            lc_f = [h for h in lc_f if h in ref_set]
         assert lc_f, "no comparable post-boundary consensus"
         start = ref.index(lc_f[0])
         # ref may contain boundary events the late node ordered
