@@ -97,6 +97,11 @@ def cmd_run(args) -> int:
             else (0.25 if args.engine == "tpu" else 0.0)),
         pipeline_depth=args.pipeline_depth,
         engine_prewarm=not args.no_prewarm,
+        breaker_threshold=0 if args.no_breaker else args.breaker_threshold,
+        breaker_base_backoff=args.breaker_backoff / 1000.0,
+        sync_retries=args.sync_retries,
+        engine_failover_threshold=(
+            0 if args.no_failover else args.engine_failover_threshold),
         logger=logger,
     )
 
@@ -201,6 +206,27 @@ def build_parser() -> argparse.ArgumentParser:
     rn.add_argument("--no_prewarm", action="store_true",
                     help="skip compiling the engine's cold-start kernel "
                          "ladder at boot (tpu engine)")
+    # -- fault tolerance (docs/robustness.md) ---------------------------
+    rn.add_argument("--breaker_threshold", type=int, default=3,
+                    help="consecutive sync failures before a peer's "
+                         "circuit breaker trips and the peer is "
+                         "suspended with exponential backoff")
+    rn.add_argument("--breaker_backoff", type=int, default=500,
+                    help="base suspension in milliseconds (doubles per "
+                         "trip, jittered, capped at 30s)")
+    rn.add_argument("--no_breaker", action="store_true",
+                    help="disable peer health tracking (reference "
+                         "behavior: dead peers are re-selected forever)")
+    rn.add_argument("--sync_retries", type=int, default=1,
+                    help="bounded retries for the idempotent gossip "
+                         "pull before the round is abandoned")
+    rn.add_argument("--engine_failover_threshold", type=int, default=3,
+                    help="consecutive device-pass failures before the "
+                         "node rebuilds consensus on the host engine "
+                         "and keeps babbling (tpu engine)")
+    rn.add_argument("--no_failover", action="store_true",
+                    help="disable the device->host engine failover "
+                         "watchdog")
     rn.set_defaults(fn=cmd_run)
 
     vs = sub.add_parser("version", help="print version")

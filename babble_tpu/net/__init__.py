@@ -18,6 +18,7 @@ from .transport import (
     Transport,
     TransportError,
 )
+from .faulty_transport import FaultSpec, FaultyTransport
 from .inmem_transport import InmemTransport, new_inmem_addr
 from .tcp_transport import TCPTransport
 
@@ -35,6 +36,8 @@ __all__ = [
     "EagerSyncResponse",
     "Transport",
     "TransportError",
+    "FaultSpec",
+    "FaultyTransport",
     "InmemTransport",
     "new_inmem_addr",
     "TCPTransport",

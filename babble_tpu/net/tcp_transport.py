@@ -81,7 +81,16 @@ class TCPTransport:
         advertise: Optional[str] = None,
         max_pool: int = 3,
         timeout: float = 1.0,
+        response_timeout: Optional[float] = None,
+        consumer_buffer: int = 16,
     ):
+        """`timeout` bounds outbound socket operations; a connection
+        handler waits `response_timeout` (default 10x timeout) for the
+        node to answer an inbound RPC before reporting a handler
+        timeout to the caller. `consumer_buffer` caps queued inbound
+        RPCs — when it is full the handler answers with a
+        TransportError immediately instead of stalling its connection
+        (overload is signalled, not absorbed)."""
         host, port_s = bind_addr.rsplit(":", 1)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -92,11 +101,14 @@ class TCPTransport:
         if self._addr.startswith(":"):
             raise TransportError("local bind address is not advertisable")
 
-        self._consumer: "queue.Queue[RPC]" = queue.Queue(16)
+        self._consumer: "queue.Queue[RPC]" = queue.Queue(max(1, consumer_buffer))
         self._pool: Dict[str, List[_Conn]] = {}
         self._pool_lock = threading.Lock()
         self._max_pool = max_pool
         self._timeout = timeout
+        self._response_timeout = (
+            response_timeout if response_timeout is not None
+            else timeout * 10)
         self._shutdown = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -205,9 +217,18 @@ class TCPTransport:
                     continue
 
                 rpc = RPC(cmd)
-                self._consumer.put(rpc)
                 try:
-                    rpc_resp = rpc.resp_chan.get(timeout=self._timeout * 10)
+                    self._consumer.put_nowait(rpc)
+                except queue.Full:
+                    # Overloaded node: fail the RPC immediately instead
+                    # of blocking this handler thread (which would also
+                    # stall every later RPC on this connection).
+                    conn.send_json("consumer queue full")
+                    conn.send_json({})
+                    continue
+                try:
+                    rpc_resp = rpc.resp_chan.get(
+                        timeout=self._response_timeout)
                 except queue.Empty:
                     conn.send_json("rpc handler timed out")
                     conn.send_json({})

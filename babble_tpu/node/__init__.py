@@ -6,13 +6,18 @@ from .config import Config
 from .control_timer import ControlTimer
 from .core import Core
 from .node import Node
-from .peer_selector import PeerSelector, RandomPeerSelector
+from .peer_selector import (
+    HealthTrackingPeerSelector,
+    PeerSelector,
+    RandomPeerSelector,
+)
 from .state import NodeState
 
 __all__ = [
     "Config",
     "ControlTimer",
     "Core",
+    "HealthTrackingPeerSelector",
     "Node",
     "NodeState",
     "PeerSelector",

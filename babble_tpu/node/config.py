@@ -62,6 +62,34 @@ class Config:
     # sibling engine would transiently exceed the prewarm memory
     # budget (very large n).
     engine_prewarm: bool = True
+    # -- fault tolerance (docs/robustness.md) --------------------------
+    # Per-peer circuit breaker (HealthTrackingPeerSelector): a peer
+    # failing breaker_threshold consecutive syncs is suspended for a
+    # jittered exponential backoff (base..max seconds, doubling per
+    # trip), then probed once before reinstatement. threshold <= 0
+    # disables health tracking (reference RandomPeerSelector behavior:
+    # a dead peer is re-selected forever, burning a gossip slot on a
+    # full transport timeout each time).
+    breaker_threshold: int = 3
+    breaker_base_backoff: float = 0.5
+    breaker_max_backoff: float = 30.0
+    breaker_jitter: float = 0.2
+    # Bounded retry for the gossip pull path. Pulls are idempotent
+    # (event inserts are hash-deduped, Core.sync skips duplicates), so
+    # a transient transport failure is retried up to sync_retries times
+    # with jittered exponential backoff before the round is abandoned
+    # and the failure reported to the breaker. 0 = fail fast.
+    sync_retries: int = 1
+    sync_retry_backoff: float = 0.05
+    # Engine failover watchdog: after this many CONSECUTIVE device-pass
+    # failures (dispatch or collect raising) the node rebuilds consensus
+    # state on the host engine from the store and keeps babbling —
+    # byte-identical order is preserved (both engines agree, PR 1
+    # parity tests), only throughput degrades. Surfaced in get_stats()
+    # as engine_state/engine_failovers. <= 0 disables failover (a
+    # wedged device engine then just logs every interval, the pre-PR-2
+    # behavior).
+    engine_failover_threshold: int = 3
     logger: logging.Logger = field(default_factory=_default_logger)
 
 

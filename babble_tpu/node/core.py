@@ -32,11 +32,17 @@ class Core:
         engine: str = "host",
         engine_mesh: int = 0,
         engine_prewarm: bool = False,
+        engine_opts: Optional[Dict] = None,
     ):
         self.id = id
         self.key = key
         self._pub_key: Optional[bytes] = None
         self._hex_id: str = ""
+        self._commit_callback = commit_callback
+        # "host" | "device" | "failed_over" (device engine replaced by
+        # the host engine after repeated device-pass failures).
+        self.engine_state = "device" if engine == "tpu" else "host"
+        self.engine_failovers = 0
         if engine == "tpu":
             # Device-backed consensus behind the same seam — the
             # JaxStore-sibling integration of SURVEY §7 step 3.
@@ -85,12 +91,15 @@ class Core:
             # realistic session at small n; chain buckets scale down
             # with n^2 so large-validator nodes keep the same budget.
             n_p = len(participants)
-            cap = 65536
-            k_cap = max(64, min(cap, (1 << 28) // (4 * n_p * n_p)))
+            opts = engine_opts or {}
+            cap = opts.get("capacity", 65536)
+            k_cap = opts.get(
+                "k_capacity",
+                max(64, min(cap, (1 << 28) // (4 * n_p * n_p))))
             self.hg: Hashgraph = TpuHashgraph(
                 participants, store, commit_callback, mesh=mesh,
-                capacity=cap, block=512, k_capacity=k_cap,
-                prewarm=engine_prewarm)
+                capacity=cap, block=opts.get("block", 512),
+                k_capacity=k_cap, prewarm=engine_prewarm)
         elif engine == "host":
             self.hg = Hashgraph(participants, store, commit_callback)
         else:
@@ -303,6 +312,88 @@ class Core:
     def abandon_consensus(self, pending) -> None:
         if pending is not None and hasattr(self.hg, "abandon_consensus"):
             self.hg.abandon_consensus(pending)
+
+    # -- engine failover (device -> host) -----------------------------------
+
+    def failover_to_host(self) -> None:
+        """Rebuild consensus state on the HOST engine from the Store and
+        swap it in, abandoning a wedged device engine (caller holds the
+        core lock; triggered by the node's watchdog after N consecutive
+        device-pass failures).
+
+        Safety: both engines compute byte-identical consensus from the
+        same DAG (PR 1 parity tests), so replaying the store's event log
+        into a fresh host engine reproduces exactly the prefix the
+        device engine already committed — commits for rounds at or
+        below the device's last consensus round are suppressed during
+        replay (they were already delivered to the app), while anything
+        the replay decides BEYOND that round is emitted normally, so no
+        committed block is lost or double-applied.
+
+        The rebuilt store is in-memory: failover trades persistence for
+        availability (a file-store node that fails over must fast-sync
+        after its next restart). Replay re-verifies every signature —
+        O(E) ECDSA — so expect seconds, not millis, on a large DAG;
+        that is the price of not trusting a failing engine's mirror."""
+        old = self.hg
+        if not hasattr(old, "dispatch_consensus"):
+            return  # already on the host engine
+        old_store = old.store
+        old_lcr = old.last_consensus_round
+
+        # The full surviving event log, oldest first. Event objects are
+        # shared with the old store; insert_event below recomputes the
+        # host-side coordinates the device engine never populated.
+        events: List[Event] = []
+        for pk in self.participants:
+            for ehex in old_store.participant_events(pk, -1):
+                events.append(old_store.get_event(ehex))
+        events.sort(key=lambda e: e.topological_index)
+
+        # Carry the roots (non-trivial after a fast-forward reset) into
+        # a fresh store: replaying into the OLD store is impossible —
+        # its per-participant tips would fail every CheckSelfParent.
+        from ..hashgraph.inmem_store import InmemStore
+
+        roots = {pk: old_store.get_root(pk) for pk in self.participants}
+        new_store = InmemStore(self.participants, old_store.cache_size())
+        new_store.reset(roots)
+
+        cb = self._commit_callback
+
+        def gated_commit(block: Block) -> None:
+            # Rounds the device engine already decided were committed
+            # before the failure; re-emitting them would double-apply
+            # app state (cf. Hashgraph.bootstrap's replay suppression).
+            if old_lcr is not None and block.round_received <= old_lcr:
+                return
+            if cb is not None:
+                cb(block)
+
+        new_hg = Hashgraph(self.participants, new_store, gated_commit)
+        for ev in events:
+            # Strip device-era consensus annotations so the replay
+            # recomputes them from scratch (they would otherwise leak
+            # into find_order before the host decides the round).
+            ev.round_received = None
+            try:
+                new_hg.insert_event(ev, True)
+            except StoreError:
+                # Same fallback as fast_forward replay: an other-parent
+                # outside the frame cannot carry wire info.
+                new_hg.insert_event(ev, False)
+        new_hg.run_consensus()
+        new_hg.commit_callback = cb
+
+        if hasattr(old, "engine"):
+            try:
+                old.engine.close()  # stop the device staging worker
+            except Exception:  # noqa: BLE001 - the engine is already sick
+                pass
+        self.hg = new_hg
+        self._recover_head_and_seq()
+        self.engine_state = "failed_over"
+        self.engine_failovers += 1
 
     def _merge_engine_phases(self) -> None:
         # Device-engine sub-phases (coords/fd/fused dispatch/pull/

@@ -13,12 +13,19 @@ multiplexing onto one work queue.
 
 Divergence from the reference (improvement): syncRequests/syncErrors
 are actually incremented, so the sync_rate stat is live (the reference
-declares the counters but never updates them — node/node.go:46-47,575)."""
+declares the counters but never updates them — node/node.go:46-47,575).
+
+Fault tolerance (docs/robustness.md): gossip outcomes feed a per-peer
+circuit breaker (HealthTrackingPeerSelector), the idempotent pull path
+retries with jittered backoff, and a watchdog fails a wedged device
+engine over to the host engine — none of which exists in the
+reference, whose gossip loop retries dead peers forever."""
 
 from __future__ import annotations
 
 import contextlib
 import queue
+import random
 import threading
 import time
 from typing import Dict, List, Optional
@@ -41,7 +48,7 @@ from ..proxy.proxy import AppProxy
 from .config import Config
 from .control_timer import ControlTimer
 from .core import Core
-from .peer_selector import RandomPeerSelector
+from .peer_selector import HealthTrackingPeerSelector, RandomPeerSelector
 from .state import NodeState, StateMachine
 
 
@@ -69,12 +76,23 @@ class Node:
             engine=getattr(conf, "engine", "host"),
             engine_mesh=getattr(conf, "engine_mesh", 0),
             engine_prewarm=getattr(conf, "engine_prewarm", False),
+            engine_opts=getattr(conf, "engine_opts", None),
         )
         self.core_lock = threading.Lock()
         # At most two gossip rounds in flight (see _babble).
         self._gossip_slots = threading.Semaphore(2)
 
-        self.peer_selector = RandomPeerSelector(participants, self.local_addr)
+        if getattr(conf, "breaker_threshold", 0) > 0:
+            self.peer_selector = HealthTrackingPeerSelector(
+                participants, self.local_addr,
+                threshold=conf.breaker_threshold,
+                base_backoff=conf.breaker_base_backoff,
+                max_backoff=conf.breaker_max_backoff,
+                jitter=conf.breaker_jitter,
+            )
+        else:
+            self.peer_selector = RandomPeerSelector(
+                participants, self.local_addr)
         self.selector_lock = threading.Lock()
 
         self.trans = trans
@@ -197,8 +215,15 @@ class Node:
                         spawned = False
                         try:
                             proceed = self._pre_gossip()
-                            peer = (self.peer_selector.next()
-                                    if proceed else None)
+                            if proceed:
+                                # Under the selector lock: next() can
+                                # mutate breaker state (half-open probe
+                                # promotion) and races the gossip
+                                # threads' outcome records.
+                                with self.selector_lock:
+                                    peer = self.peer_selector.next()
+                            else:
+                                peer = None
                             if peer is not None:
                                 addr = peer.net_addr
                                 self.state.go_func(
@@ -277,6 +302,8 @@ class Node:
         pipelined = (getattr(self.conf, "pipeline_depth", 0) > 0
                      and self.core.supports_pipeline())
         pending = None
+        failover_at = getattr(self.conf, "engine_failover_threshold", 0)
+        engine_failures = 0  # consecutive device-pass failures
         while not self._shutdown.is_set():
             self._shutdown.wait(min(max(iv_min, 2.0 * ema), iv_max))
             if self._shutdown.is_set():
@@ -294,12 +321,41 @@ class Node:
                     else:
                         self.core.run_consensus(
                             unlocked=self._core_unlocked)
+                engine_failures = 0
             except Exception as exc:  # noqa: BLE001 - keep the loop alive
                 # A failed collect restores its batch to the engine's
                 # staging list; a stale pending (engine replaced by
                 # fast-forward reset) is simply dropped.
                 pending = None
+                engine_failures += 1
                 self.logger.error("consensus pass failed: %s", exc)
+                # Watchdog: a device engine failing every pass never
+                # recovers on its own (wedged runtime, poisoned compile
+                # cache, lost tunnel). Rebuild on the host engine and
+                # keep babbling — degraded throughput beats a node
+                # that commits nothing forever.
+                if (failover_at > 0
+                        and engine_failures >= failover_at
+                        and self.core.supports_pipeline()):
+                    try:
+                        self.logger.error(
+                            "device engine failed %d consecutive passes;"
+                            " failing over to the host engine",
+                            engine_failures)
+                        with self.core_lock:
+                            self.core.failover_to_host()
+                        pipelined = False
+                        engine_failures = 0
+                        self.logger.warning(
+                            "engine failover complete: host engine "
+                            "rebuilt from store (failovers=%d)",
+                            self.core.engine_failovers)
+                    except Exception as fexc:  # noqa: BLE001
+                        # Store aged out early history, or the rebuild
+                        # itself failed: stay on the (sick) device
+                        # engine and keep retrying passes.
+                        self.logger.error(
+                            "engine failover failed: %s", fexc)
             dt = time.monotonic() - t0
             if dt < 10.0:
                 # Compile stalls (tens of seconds on a tunneled chip)
@@ -340,6 +396,24 @@ class Node:
                 return False
             return True
 
+    # -- peer health feedback (circuit breaker) ---------------------------
+
+    def _peer_ok(self, peer_addr: str) -> None:
+        with self.selector_lock:
+            record = getattr(self.peer_selector, "record_success", None)
+            reinstated = record(peer_addr) if record else False
+        if reinstated:
+            self.logger.info("peer %s reinstated (probe succeeded)",
+                             peer_addr)
+
+    def _peer_failed(self, peer_addr: str) -> None:
+        with self.selector_lock:
+            record = getattr(self.peer_selector, "record_failure", None)
+            tripped = record(peer_addr) if record else False
+        if tripped:
+            self.logger.warning(
+                "peer %s suspended (circuit breaker tripped)", peer_addr)
+
     def _gossip(self, peer_addr: str) -> None:
         if self._shutdown.is_set():
             return
@@ -347,12 +421,17 @@ class Node:
             sync_limit, other_known = self._pull(peer_addr)
         except TransportError as exc:
             self.logger.debug("pull from %s failed: %s", peer_addr, exc)
+            self._peer_failed(peer_addr)
             return
         except Exception as exc:  # noqa: BLE001
             self.logger.error("pull from %s failed: %s", peer_addr, exc)
+            self._peer_failed(peer_addr)
             return
 
         if sync_limit:
+            # The peer answered (it is healthy) — WE are the ones
+            # lagging behind.
+            self._peer_ok(peer_addr)
             self.state.set_state(NodeState.CATCHING_UP)
             return
 
@@ -360,13 +439,35 @@ class Node:
             self._push(peer_addr, other_known)
         except Exception as exc:  # noqa: BLE001
             self.logger.debug("push to %s failed: %s", peer_addr, exc)
+            self._peer_failed(peer_addr)
             return
 
+        self._peer_ok(peer_addr)
         with self.selector_lock:
             self.peer_selector.update_last(peer_addr)
         self.state.set_starting(False)
 
     def _pull(self, peer_addr: str):
+        """Pull with bounded, jittered retry. Safe to retry: the sync
+        response is inserted through Core.sync, which hash-dedupes
+        events, so a response that was applied but whose push leg then
+        failed cannot double-insert on the retry."""
+        attempts = 1 + max(0, getattr(self.conf, "sync_retries", 0))
+        backoff = getattr(self.conf, "sync_retry_backoff", 0.05)
+        for attempt in range(attempts):
+            try:
+                return self._pull_once(peer_addr)
+            except TransportError:
+                if attempt == attempts - 1:
+                    raise
+                # Jittered exponential backoff between attempts; a
+                # shutdown mid-wait aborts the round immediately.
+                delay = backoff * (2.0 ** attempt)
+                delay *= 1.0 + 0.5 * random.random()
+                if self._shutdown.wait(delay):
+                    raise
+
+    def _pull_once(self, peer_addr: str):
         if self._shutdown.is_set():
             raise TransportError("node is shutting down")
         with self.core_lock:
@@ -440,10 +541,12 @@ class Node:
                     self.core.fast_forward(roots, events)
                 with self._stats_lock:
                     self.fast_forwards += 1
+                self._peer_ok(peer.net_addr)
                 self.logger.info(
                     "fast-forward from %s: %d frame events",
                     peer.net_addr, len(events))
             except Exception as exc:  # noqa: BLE001
+                self._peer_failed(peer.net_addr)
                 self.logger.error(
                     "fast-forward from %s failed: %s", peer.net_addr, exc)
         self.state.set_state(NodeState.BABBLING)
@@ -453,7 +556,19 @@ class Node:
     def _process_rpc(self, rpc: RPC) -> None:
         state = self.state.get_state()
         if state != NodeState.BABBLING:
-            rpc.respond(SyncResponse(self.id), TransportError(f"not ready: {state}"))
+            # Answer with the response type matching the request — an
+            # EagerSync/FastForward caller fed a SyncResponse would die
+            # on the response-type check instead of the real error.
+            cmd = rpc.command
+            if isinstance(cmd, EagerSyncRequest):
+                resp = EagerSyncResponse(self.id, False)
+            elif isinstance(cmd, FastForwardRequest):
+                resp = FastForwardResponse(self.id)
+            elif isinstance(cmd, SyncRequest):
+                resp = SyncResponse(self.id)
+            else:
+                resp = None
+            rpc.respond(resp, TransportError(f"not ready: {state}"))
             return
         cmd = rpc.command
         if isinstance(cmd, SyncRequest):
@@ -540,6 +655,16 @@ class Node:
 
     def get_stats(self) -> Dict[str, str]:
         elapsed = time.monotonic() - self.start_time
+        # Snapshot the gossip counters under the lock they are
+        # incremented under — unlocked reads could pair a fresh
+        # sync_errors with a stale sync_requests and report a rate
+        # above 1 (or below 0).
+        with self._stats_lock:
+            sync_requests = self.sync_requests
+            sync_errors = self.sync_errors
+            fast_forwards = self.fast_forwards
+        sync_rate = (1.0 - sync_errors / sync_requests
+                     if sync_requests else 1.0)
         consensus_events = self.core.get_consensus_events_count()
         events_per_second = consensus_events / elapsed if elapsed > 0 else 0.0
         last_consensus_round = self.core.get_last_consensus_round_index()
@@ -559,8 +684,11 @@ class Node:
             "undetermined_events": str(len(self.core.get_undetermined_events())),
             "transaction_pool": str(len(self.core.transaction_pool)),
             "num_peers": str(len(self.peer_selector.peers())),
-            "sync_rate": f"{self.sync_rate():.2f}",
-            "fast_forwards": str(self.fast_forwards),
+            "sync_rate": f"{sync_rate:.2f}",
+            "fast_forwards": str(fast_forwards),
+            "engine_state": self.core.engine_state,
+            "engine_failovers": str(self.core.engine_failovers),
+            "suspended_peers": str(self._suspended_peer_count()),
             "events_per_second": f"{events_per_second:.2f}",
             "rounds_per_second": f"{rounds_per_second:.2f}",
             "round_events": str(self.core.get_last_commited_round_events_count()),
@@ -579,6 +707,22 @@ class Node:
         }
 
     def sync_rate(self) -> float:
-        if self.sync_requests == 0:
-            return 1.0
-        return 1.0 - self.sync_errors / self.sync_requests
+        with self._stats_lock:
+            if self.sync_requests == 0:
+                return 1.0
+            return 1.0 - self.sync_errors / self.sync_requests
+
+    def _suspended_peer_count(self) -> int:
+        with self.selector_lock:
+            snapshot = getattr(self.peer_selector, "snapshot", None)
+            if snapshot is None:
+                return 0
+            return sum(1 for h in snapshot().values()
+                       if h["state"] != "closed")
+
+    def get_peer_stats(self) -> Dict[str, dict]:
+        """Per-peer breaker states for /debug/peers — empty when
+        health tracking is disabled (RandomPeerSelector)."""
+        with self.selector_lock:
+            snapshot = getattr(self.peer_selector, "snapshot", None)
+            return snapshot() if snapshot else {}

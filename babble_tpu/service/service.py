@@ -82,6 +82,17 @@ class Service:
                                                None),
                         }
                     self._json(200, out)
+                elif url.path.rstrip("/") == "/debug/peers":
+                    # Fault-tolerance view (docs/robustness.md): per-
+                    # peer circuit-breaker states plus the engine
+                    # degradation counters — the first place to look
+                    # when a net is slow or a node stopped committing.
+                    core = service.node.core
+                    self._json(200, {
+                        "engine_state": core.engine_state,
+                        "engine_failovers": core.engine_failovers,
+                        "peers": service.node.get_peer_stats(),
+                    })
                 elif url.path.rstrip("/") == "/debug/profile":
                     # Like the reference's pprof mount, this is an
                     # operator tool: bind service_addr to localhost in

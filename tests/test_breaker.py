@@ -1,0 +1,268 @@
+"""Peer health circuit breaker (HealthTrackingPeerSelector) and the
+bounded gossip-pull retry.
+
+Unit tests drive the breaker state machine with a fake clock and a
+seeded rng (fully deterministic); the integration test proves the
+production property: a dead peer is suspended instead of burning a
+gossip slot on every unlucky pick, and is probed and reinstated when it
+comes back."""
+
+from __future__ import annotations
+
+import random
+import time
+
+from babble_tpu.net import TransportError
+from babble_tpu.net.peer import Peer
+from babble_tpu.node import HealthTrackingPeerSelector
+from babble_tpu.node.peer_selector import CLOSED, HALF_OPEN, OPEN
+
+from test_node import check_gossip, make_nodes
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def make_selector(n=4, **kw):
+    peers = [Peer(f"addr{i}", f"0xPUB{i}") for i in range(n)]
+    clock = FakeClock()
+    kw.setdefault("threshold", 2)
+    kw.setdefault("base_backoff", 1.0)
+    kw.setdefault("max_backoff", 8.0)
+    kw.setdefault("jitter", 0.0)
+    kw.setdefault("rng", random.Random(42))
+    sel = HealthTrackingPeerSelector(peers, "addr0", clock=clock, **kw)
+    return sel, clock
+
+
+# ------------------------------------------------------------- unit
+
+
+def test_selector_excludes_self_and_last():
+    sel, _ = make_selector(4)
+    assert {p.net_addr for p in sel.peers()} == {"addr1", "addr2", "addr3"}
+    sel.update_last("addr1")
+    picks = {sel.next().net_addr for _ in range(50)}
+    assert picks == {"addr2", "addr3"}
+
+
+def test_breaker_trips_after_threshold_and_backs_off():
+    sel, clock = make_selector(4)
+    assert not sel.record_failure("addr1")  # 1 of 2: still closed
+    assert sel.snapshot()["addr1"]["state"] == CLOSED
+    assert sel.record_failure("addr1")  # 2 of 2: tripped
+    snap = sel.snapshot()["addr1"]
+    assert snap["state"] == OPEN
+    assert snap["trips"] == 1
+    assert snap["backoff"] == 1.0  # base, jitter 0
+    # Suspended: never selected while the deadline is in the future.
+    picks = {sel.next().net_addr for _ in range(50)}
+    assert "addr1" not in picks
+
+
+def test_breaker_half_open_probe_then_reinstate():
+    sel, clock = make_selector(4)
+    sel.record_failure("addr1")
+    sel.record_failure("addr1")
+    clock.advance(1.01)  # past the (unjittered) 1.0s backoff
+    probe = sel.next()
+    assert probe.net_addr == "addr1"  # probe preempts healthy picks
+    assert sel.snapshot()["addr1"]["state"] == HALF_OPEN
+    # While the probe is out (within its window) the peer is not
+    # selected again.
+    picks = {sel.next().net_addr for _ in range(50)}
+    assert "addr1" not in picks
+    # Probe succeeded: fully reinstated.
+    assert sel.record_success("addr1")  # True = reinstated
+    snap = sel.snapshot()["addr1"]
+    assert snap["state"] == CLOSED and snap["backoff"] == 0.0
+    picks = {sel.next().net_addr for _ in range(100)}
+    assert "addr1" in picks
+
+
+def test_breaker_failed_probe_doubles_backoff_capped():
+    sel, clock = make_selector(4)
+    sel.record_failure("addr1")
+    sel.record_failure("addr1")
+    backoffs = [sel.snapshot()["addr1"]["backoff"]]
+    for _ in range(5):
+        clock.advance(100.0)
+        assert sel.next().net_addr == "addr1"  # probe
+        assert sel.record_failure("addr1")  # failed probe -> reopen
+        backoffs.append(sel.snapshot()["addr1"]["backoff"])
+    assert backoffs == [1.0, 2.0, 4.0, 8.0, 8.0, 8.0]  # doubles, caps
+
+
+def test_breaker_jitter_bounds():
+    sel, clock = make_selector(4, jitter=0.2)
+    sel.record_failure("addr1")
+    sel.record_failure("addr1")
+    retry_in = sel.snapshot()["addr1"]["retry_in"]
+    assert 0.8 <= retry_in <= 1.2  # base 1.0 +/- 20%
+
+
+def test_all_peers_suspended_returns_none():
+    sel, clock = make_selector(3)  # peers addr1, addr2
+    for addr in ("addr1", "addr2"):
+        sel.record_failure(addr)
+        sel.record_failure(addr)
+    assert sel.next() is None
+    # After the backoff both become probe-able again.
+    clock.advance(2.0)
+    assert sel.next() is not None
+
+
+def test_lost_probe_outcome_rearms():
+    """A half-open probe whose outcome is never recorded (gossip thread
+    died first) must not wedge the peer in HALF_OPEN forever."""
+    sel, clock = make_selector(4)
+    sel.record_failure("addr1")
+    sel.record_failure("addr1")
+    clock.advance(1.01)
+    assert sel.next().net_addr == "addr1"  # probe dispatched, outcome lost
+    clock.advance(10.0)  # probe window long gone
+    assert sel.next().net_addr == "addr1"  # re-probed
+
+
+# ------------------------------------------------------ pull retry
+
+
+def test_pull_retries_transient_transport_failures():
+    nodes = make_nodes(2, "inmem")
+    try:
+        nodes[1].run_async(gossip=False)  # serve RPCs only
+        orig_sync = nodes[0].trans.sync
+        calls = {"n": 0}
+
+        def flaky(target, args):
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise TransportError("injected transient failure")
+            return orig_sync(target, args)
+
+        nodes[0].trans.sync = flaky
+        nodes[0].conf.sync_retries = 2
+        nodes[0].conf.sync_retry_backoff = 0.01
+        sync_limit, known = nodes[0]._pull(nodes[1].local_addr)
+        assert not sync_limit and known is not None
+        assert calls["n"] == 3
+        # Every attempt was a real request; the failures are counted.
+        with nodes[0]._stats_lock:
+            assert nodes[0].sync_requests == 3
+            assert nodes[0].sync_errors == 2
+    finally:
+        for node in nodes:
+            node.shutdown()
+
+
+def test_pull_retry_bounded():
+    nodes = make_nodes(2, "inmem")
+    try:
+        calls = {"n": 0}
+
+        def always_down(target, args):
+            calls["n"] += 1
+            raise TransportError("injected dead peer")
+
+        nodes[0].trans.sync = always_down
+        nodes[0].conf.sync_retries = 2
+        nodes[0].conf.sync_retry_backoff = 0.01
+        try:
+            nodes[0]._pull(nodes[1].local_addr)
+            raise AssertionError("pull should have failed")
+        except TransportError:
+            pass
+        assert calls["n"] == 3  # 1 + sync_retries, no more
+    finally:
+        for node in nodes:
+            node.shutdown()
+
+
+# ---------------------------------------------------- integration
+
+
+def test_dead_peer_suspended_then_reinstated():
+    """4-node net, one peer dead (unreachable): the running nodes trip
+    its breaker and keep gossiping at full speed among themselves;
+    when the peer comes back it is probed and reinstated, and the
+    whole net converges to one order."""
+    nodes = make_nodes(4, "inmem")
+    running, dead = nodes[:3], nodes[3]
+    dead_addr = dead.local_addr
+    # Tight breaker for test speed.
+    for nd in running:
+        nd.peer_selector = HealthTrackingPeerSelector(
+            nd.peer_selector.peers(), nd.local_addr,
+            threshold=2, base_backoff=0.3, max_backoff=1.5, jitter=0.1)
+        nd.conf.sync_retries = 0  # fail fast: breaker under test
+    # Dead = unreachable: instant connect failure, like a dropped box.
+    for nd in running:
+        nd.trans.disconnect(dead_addr)
+
+    try:
+        for nd in running:
+            nd.run_async(gossip=True)
+        deadline = time.monotonic() + 60.0
+        i = 0
+        suspended_seen = False
+        while time.monotonic() < deadline:
+            running[i % 3].submit_tx(f"tx {i}".encode())
+            i += 1
+            if not suspended_seen:
+                suspended_seen = any(
+                    nd.get_peer_stats().get(dead_addr, {}).get("trips", 0) > 0
+                    for nd in running)
+            rounds_ok = all(
+                (nd.core.get_last_consensus_round_index() or 0) >= 5
+                for nd in running)
+            if suspended_seen and rounds_ok:
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError(
+                f"suspended_seen={suspended_seen}, rounds="
+                f"{[nd.core.get_last_consensus_round_index() for nd in running]}")
+
+        # The dead peer is suspended, not re-timed-out every round:
+        # after the breaker trips, failure counts stop climbing with
+        # gossip volume (only sparse probes touch it).
+        fails_a = [nd.get_peer_stats()[dead_addr]["failures"]
+                   for nd in running]
+        time.sleep(1.0)  # plenty of heartbeats at 10ms
+        fails_b = [nd.get_peer_stats()[dead_addr]["failures"]
+                   for nd in running]
+        assert sum(fails_b) - sum(fails_a) <= 9, (
+            f"dead peer still hammered: {fails_a} -> {fails_b}")
+
+        # Resurrection: reconnect and run the node.
+        for nd in running:
+            nd.trans.connect(dead_addr, dead.trans)
+        dead.run_async(gossip=True)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            nodes[i % 4].submit_tx(f"tx {i}".encode())
+            i += 1
+            reinstated = any(
+                nd.get_peer_stats()[dead_addr]["state"] == "closed"
+                and nd.get_peer_stats()[dead_addr]["successes"] > 0
+                for nd in running)
+            caught_up = (dead.core.get_last_consensus_round_index() or 0) >= 5
+            if reinstated and caught_up:
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError(
+                f"never reinstated: {[nd.get_peer_stats()[dead_addr] for nd in running]}, "
+                f"dead round={dead.core.get_last_consensus_round_index()}")
+    finally:
+        for nd in nodes:
+            nd.shutdown()
+    check_gossip(nodes)

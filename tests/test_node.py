@@ -357,6 +357,81 @@ def test_sync_limit():
             node.shutdown()
 
 
+def test_not_ready_rpc_matches_request_type():
+    """A node that is not BABBLING must answer each RPC with the
+    response type its caller expects — a SyncResponse to an EagerSync
+    caller dies on the response-type check instead of surfacing the
+    real 'not ready' error."""
+    from babble_tpu.net.transport import (
+        EagerSyncRequest,
+        EagerSyncResponse,
+        FastForwardRequest,
+        FastForwardResponse,
+        RPC,
+        SyncRequest,
+        SyncResponse,
+    )
+    from babble_tpu.node.state import NodeState
+
+    nodes = make_nodes(2, "inmem")
+    try:
+        nodes[0].state.set_state(NodeState.CATCHING_UP)
+        for cmd, expected in (
+            (SyncRequest(1, {}), SyncResponse),
+            (EagerSyncRequest(1, []), EagerSyncResponse),
+            (FastForwardRequest(1), FastForwardResponse),
+        ):
+            rpc = RPC(cmd)
+            nodes[0]._process_rpc(rpc)
+            out = rpc.resp_chan.get(timeout=1.0)
+            assert isinstance(out.response, expected), (
+                f"{type(cmd).__name__} answered with "
+                f"{type(out.response).__name__}")
+            assert out.error is not None and "not ready" in str(out.error)
+    finally:
+        for node in nodes:
+            node.shutdown()
+
+
+def test_fast_forward_failure_drops_back_to_babbling():
+    """CatchingUp resilience: a garbage frame from the peer, or the
+    transport raising mid fast-forward, must drop the node back to
+    BABBLING with gossip still functional — not wedge it in
+    CatchingUp."""
+    from babble_tpu.net.transport import FastForwardResponse, TransportError
+    from babble_tpu.node.state import NodeState
+
+    nodes = make_nodes(2, "inmem")
+    try:
+        nodes[1].run_async(gossip=False)  # serves RPCs
+
+        # Peer returns a garbage frame: deserialization blows up.
+        nodes[0].trans.fast_forward = lambda target, args: \
+            FastForwardResponse(1, roots={}, events=[{"garbage": 1}])
+        nodes[0].state.set_state(NodeState.CATCHING_UP)
+        nodes[0]._fast_forward()
+        assert nodes[0].state.get_state() == NodeState.BABBLING
+        assert nodes[0].fast_forwards == 0
+
+        # Transport raises mid-flight.
+        def raising_ff(target, args):
+            raise TransportError("injected mid-flight failure")
+
+        nodes[0].trans.fast_forward = raising_ff
+        nodes[0].state.set_state(NodeState.CATCHING_UP)
+        nodes[0]._fast_forward()
+        assert nodes[0].state.get_state() == NodeState.BABBLING
+
+        # Still fully functional: a normal gossip round succeeds.
+        before = nodes[0].sync_requests
+        nodes[0]._gossip(nodes[1].local_addr)
+        assert nodes[0].sync_requests > before
+        assert nodes[0].state.get_state() == NodeState.BABBLING
+    finally:
+        for node in nodes:
+            node.shutdown()
+
+
 def test_shutdown():
     """Shutting a node down closes its transport (peers' syncs fail) and
     the second shutdown is idempotent — reference node_test.go:461-475."""

@@ -26,6 +26,22 @@ def test_stats_endpoint():
         assert float(stats["events_per_second"]) > 0
         check_gossip(nodes)
 
+        # fault-tolerance stats surfaced over HTTP (docs/robustness.md)
+        assert stats["engine_state"] == "host"
+        assert stats["engine_failovers"] == "0"
+        with urllib.request.urlopen(
+            f"http://{service.addr}/debug/peers", timeout=2
+        ) as r:
+            assert r.status == 200
+            dbg = json.loads(r.read())
+        assert dbg["engine_state"] == "host"
+        assert dbg["engine_failovers"] == 0
+        assert len(dbg["peers"]) == 3  # 4-node net, self excluded
+        for state in dbg["peers"].values():
+            assert state["state"] in ("closed", "open", "half_open")
+            assert {"failures", "successes", "trips",
+                    "retry_in"} <= set(state)
+
         # live device profiling (reference mounts pprof on the same mux,
         # cmd/babble/main.go:12)
         with urllib.request.urlopen(

@@ -253,3 +253,46 @@ def test_tcp_sync_after_peer_restart():
     finally:
         t1.close()
         t2.close()
+
+
+def test_tcp_response_timeout_and_consumer_buffer_params():
+    """The inbound-response wait and consumer queue capacity are
+    constructor parameters; a full consumer queue is answered with a
+    TransportError immediately instead of stalling the handler
+    thread."""
+    t1 = TCPTransport("127.0.0.1:0", timeout=1.0)
+    # Nobody drains t2's consumer: one slot, short handler wait.
+    t2 = TCPTransport("127.0.0.1:0", timeout=2.0,
+                      response_timeout=0.4, consumer_buffer=1)
+    assert t2._response_timeout == 0.4
+    assert t2._consumer.maxsize == 1
+    # Default derivation unchanged: 10x timeout.
+    assert t1._response_timeout == 10.0
+    results = {}
+
+    def call(tag):
+        t0 = time.monotonic()
+        try:
+            t1.sync(t2.local_addr(), SyncRequest(0, {}))
+            results[tag] = ("ok", time.monotonic() - t0)
+        except TransportError as exc:
+            results[tag] = (str(exc), time.monotonic() - t0)
+
+    try:
+        first = threading.Thread(target=call, args=("first",))
+        first.start()
+        time.sleep(0.15)  # first RPC now fills the 1-slot queue
+        second = threading.Thread(target=call, args=("second",))
+        second.start()
+        first.join(timeout=5.0)
+        second.join(timeout=5.0)
+        # Queue full: rejected immediately, not after a timeout.
+        msg, dt = results["second"]
+        assert "consumer queue full" in msg, results
+        assert dt < 0.3, f"full-queue rejection took {dt:.2f}s"
+        # Undrained RPC: the handler reported its (shortened) timeout.
+        msg, dt = results["first"]
+        assert "rpc handler timed out" in msg, results
+    finally:
+        t1.close()
+        t2.close()
