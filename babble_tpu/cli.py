@@ -96,6 +96,7 @@ def cmd_run(args) -> int:
             if args.consensus_interval is not None
             else (0.25 if args.engine == "tpu" else 0.0)),
         pipeline_depth=args.pipeline_depth,
+        verify_workers=args.verify_workers,
         engine_prewarm=not args.no_prewarm,
         breaker_threshold=0 if args.no_breaker else args.breaker_threshold,
         breaker_base_backoff=args.breaker_backoff / 1000.0,
@@ -203,6 +204,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "commit delta collected on the next worker "
                          "wake, so device compute overlaps gossip "
                          "ingest; 0 = synchronous dispatch+collect)")
+    rn.add_argument("--verify_workers", type=int, default=-1,
+                    help="signature-verify worker pool size for sync "
+                         "ingest (batches are ECDSA-checked outside "
+                         "the core lock; -1 = one worker per core, "
+                         "capped at 8; 0/1 = inline serial)")
     rn.add_argument("--no_prewarm", action="store_true",
                     help="skip compiling the engine's cold-start kernel "
                          "ladder at boot (tpu engine)")

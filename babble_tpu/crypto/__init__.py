@@ -4,6 +4,7 @@ from .keys import (
     key_from_seed,
     pub_key_bytes,
     pub_key_from_bytes,
+    pub_key_from_bytes_cached,
     sign,
     verify,
     sha256,
@@ -16,6 +17,7 @@ __all__ = [
     "key_from_seed",
     "pub_key_bytes",
     "pub_key_from_bytes",
+    "pub_key_from_bytes_cached",
     "sign",
     "verify",
     "sha256",

@@ -13,6 +13,7 @@ the import never fails on a missing optional dependency.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 from typing import Tuple
 
@@ -85,3 +86,15 @@ else:
     pub_key_from_bytes = _fb.pub_key_from_bytes
     sign = _fb.sign
     verify = _fb.verify
+
+
+@functools.lru_cache(maxsize=1024)
+def pub_key_from_bytes_cached(pub: bytes):
+    """Keyed LRU over `pub_key_from_bytes`: a gossip network sees the
+    same n creator keys on every one of millions of events, so parsing
+    (and, on the pure-Python backend, window-table precompute) is paid
+    once per creator, not once per event. Public-key objects are
+    immutable on both backends, so sharing across threads is safe.
+    Invalid encodings raise and are NOT cached (lru_cache does not
+    memoize exceptions) — same error surface as the uncached call."""
+    return pub_key_from_bytes(pub)

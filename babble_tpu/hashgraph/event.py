@@ -21,6 +21,15 @@ from typing import List, Optional, Sequence
 from .. import crypto
 from ..gojson import BigInt, GoStruct, Timestamp, ZERO_TIME, decode_byte_slices, marshal
 
+# Marshal/hash reuse contract (docs/ingest.md): Event and EventBody
+# memoize their Go-JSON bytes and SHA-256 digests because the ingest
+# path would otherwise pay the same marshal up to three times per event
+# (body hash for signature verify, event hash for identity, re-marshal
+# when persisting/relaying). The caches are sound only while the
+# underlying fields are frozen, so every mutation MUST go through an
+# invalidating mutator (`sign`, `set_wire_info`) or call
+# `invalidate()` explicitly after touching fields by hand.
+
 
 class EventCoordinates:
     """(hash, index) pointer used in the per-participant coordinate
@@ -66,12 +75,36 @@ class EventBody(GoStruct):
         self.other_parent_creator_id = -1
         self.other_parent_index = -1
         self.creator_id = -1
+        # memoized Go-JSON encoding + digest (see module docstring)
+        self._marshal_str: Optional[str] = None
+        self._marshal: Optional[bytes] = None
+        self._hash: Optional[bytes] = None
+
+    def invalidate(self) -> None:
+        """Drop the memoized encoding/digest after a by-hand field
+        mutation. The wire-info ints are NOT part of the encoding
+        (unexported in Go), so set_wire_info does not need this."""
+        self._marshal_str = None
+        self._marshal = None
+        self._hash = None
+
+    def marshal_value(self) -> str:
+        s = self._marshal_str
+        if s is None:
+            s = self._marshal_str = GoStruct.marshal_value(self)
+        return s
 
     def marshal(self) -> bytes:
-        return marshal(self)
+        b = self._marshal
+        if b is None:
+            b = self._marshal = (self.marshal_value() + "\n").encode("utf-8")
+        return b
 
     def hash(self) -> bytes:
-        return crypto.sha256(self.marshal())
+        h = self._hash
+        if h is None:
+            h = self._hash = crypto.sha256(self.marshal())
+        return h
 
 
 class Event(GoStruct):
@@ -94,8 +127,14 @@ class Event(GoStruct):
         self.first_descendants: List[EventCoordinates] = []
 
         self._creator_hex: str = ""
+        self._marshal_str: Optional[str] = None
+        self._marshal: Optional[bytes] = None
         self._hash: bytes = b""
         self._hex: str = ""
+        # memoized signature-check result and wire form (see
+        # docs/ingest.md): sound while body/R/S are frozen.
+        self._sig_ok: Optional[bool] = None
+        self._wire: Optional["WireEvent"] = None
 
     # -- construction ------------------------------------------------------
 
@@ -142,22 +181,60 @@ class Event(GoStruct):
             return True
         return bool(self.body.transactions)
 
+    # -- cache invalidation ------------------------------------------------
+
+    def invalidate(self, body: bool = True) -> None:
+        """Centralized cache invalidation: drop every memo derived from
+        the (body, R, S) triple. `body=True` also drops the body's own
+        encoding caches — required after any by-hand body-field
+        mutation; `sign` passes body=False because it changes only
+        R/S."""
+        if body:
+            self.body.invalidate()
+            self._creator_hex = ""
+        self._marshal_str = None
+        self._marshal = None
+        self._hash = b""
+        self._hex = ""
+        self._sig_ok = None
+        self._wire = None
+
     # -- crypto ------------------------------------------------------------
 
     def sign(self, key) -> None:
         r, s = crypto.sign(key, self.body.hash())
         self.r, self.s = BigInt(r), BigInt(s)
-        self._hash = b""
-        self._hex = ""
+        self.invalidate(body=False)
+        # A signature we just produced with the creator's own key is
+        # valid by ECDSA correctness — memoize the verdict so the
+        # insert pipeline's verify() does not re-derive it (a full
+        # scalar multiplication per self-event). A mismatched key
+        # (tests, adversarial fixtures) leaves the memo unset and
+        # verify() computes the honest answer.
+        if crypto.pub_key_bytes(key) == self.body.creator:
+            self._sig_ok = True
 
     def verify(self) -> bool:
-        pub = crypto.pub_key_from_bytes(self.body.creator)
-        return crypto.verify(pub, self.body.hash(), self.r, self.s)
+        ok = self._sig_ok
+        if ok is None:
+            pub = crypto.pub_key_from_bytes_cached(self.body.creator)
+            ok = self._sig_ok = crypto.verify(
+                pub, self.body.hash(), self.r, self.s)
+        return ok
 
     # -- identity ----------------------------------------------------------
 
+    def marshal_value(self) -> str:
+        s = self._marshal_str
+        if s is None:
+            s = self._marshal_str = GoStruct.marshal_value(self)
+        return s
+
     def marshal(self) -> bytes:
-        return marshal(self)
+        b = self._marshal
+        if b is None:
+            b = self._marshal = (self.marshal_value() + "\n").encode("utf-8")
+        return b
 
     def hash(self) -> bytes:
         if not self._hash:
@@ -185,21 +262,28 @@ class Event(GoStruct):
         self.body.other_parent_creator_id = other_parent_creator_id
         self.body.other_parent_index = other_parent_index
         self.body.creator_id = creator_id
+        # The wire ints are not part of the Go-JSON encoding, so the
+        # marshal/hash/signature memos stay valid — only the cached
+        # wire form must be rebuilt.
+        self._wire = None
 
     def to_wire(self) -> "WireEvent":
-        return WireEvent(
-            body=WireBody(
-                transactions=self.body.transactions,
-                self_parent_index=self.body.self_parent_index,
-                other_parent_creator_id=self.body.other_parent_creator_id,
-                other_parent_index=self.body.other_parent_index,
-                creator_id=self.body.creator_id,
-                timestamp=self.body.timestamp,
-                index=self.body.index,
-            ),
-            r=self.r,
-            s=self.s,
-        )
+        w = self._wire
+        if w is None:
+            w = self._wire = WireEvent(
+                body=WireBody(
+                    transactions=self.body.transactions,
+                    self_parent_index=self.body.self_parent_index,
+                    other_parent_creator_id=self.body.other_parent_creator_id,
+                    other_parent_index=self.body.other_parent_index,
+                    creator_id=self.body.creator_id,
+                    timestamp=self.body.timestamp,
+                    index=self.body.index,
+                ),
+                r=self.r,
+                s=self.s,
+            )
+        return w
 
     def __repr__(self) -> str:
         return f"Event({self.creator()[:10]}#{self.index()})"
@@ -268,9 +352,16 @@ class WireEvent(GoStruct):
         self.body = body
         self.r = BigInt(r)
         self.s = BigInt(s)
+        self._dict: Optional[dict] = None
 
     def to_dict(self) -> dict:
-        return {
+        # Memoized: the same wire form is JSON-relayed once per peer
+        # (TCP transport), and WireEvents are themselves memoized per
+        # Event — callers treat the dict as read-only.
+        d = self._dict
+        if d is not None:
+            return d
+        d = self._dict = {
             "Body": {
                 "Transactions": (
                     None
@@ -287,6 +378,7 @@ class WireEvent(GoStruct):
             "R": int(self.r),
             "S": int(self.s),
         }
+        return d
 
     @classmethod
     def from_json_obj(cls, obj: dict) -> "WireEvent":

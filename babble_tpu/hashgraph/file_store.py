@@ -218,6 +218,37 @@ class FileStore:
             ).fetchall()
         return [r[0] for r in rows]
 
+    def participant_window(self, participant: str):
+        # Live hot-cache window; coordinates that aged out of it fall
+        # back to the per-event probe below, which serves from sqlite.
+        return self.inmem.participant_window(participant)
+
+    def participant_event_objects(self, participant: str, skip: int) -> List[Event]:
+        try:
+            res = self.inmem.participant_event_objects(participant, skip)
+            # Same freshly-loaded disambiguation as participant_events:
+            # an empty window is only authoritative when the participant
+            # has genuinely no events past `skip`.
+            if res:
+                return res
+            _, is_root = self.inmem.last_from(participant)
+            if not is_root:
+                return res
+        except StoreError:
+            pass
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT data, topo FROM events WHERE creator = ? AND idx > ? "
+                "ORDER BY idx",
+                (participant, skip),
+            ).fetchall()
+        out = []
+        for data, topo in rows:
+            ev = event_from_json_obj(json.loads(data))
+            ev.topological_index = topo
+            out.append(ev)
+        return out
+
     def participant_event(self, participant: str, index: int) -> str:
         try:
             return self.inmem.participant_event(participant, index)

@@ -447,6 +447,75 @@ class Hashgraph:
 
         return Event(body, r=wevent.r, s=wevent.s)
 
+    def read_wire_batch(self, wire_events: List[WireEvent]) -> List[Event]:
+        """Materialize a whole sync batch of wire events at once.
+
+        Equivalent to calling read_wire_info per event interleaved with
+        inserts, but with two batch-level shortcuts:
+
+        - later batch events routinely name earlier ones as parents;
+          those coordinates resolve against a local (creator_id, index)
+          map of the batch itself instead of requiring the parent to be
+          store-inserted first — which is what lets `Core.sync` split
+          materialize / verify / insert into separate phases;
+        - store coordinates resolve through ONE per-creator window
+          snapshot (`participant_window`) instead of two store probes
+          per event (for a FileStore whose window aged out, that was
+          two sqlite round trips per event).
+
+        Caller holds the core lock: the window snapshots are live store
+        state and must not race inserts.
+        """
+        local: Dict[tuple, str] = {}
+        windows: Dict[int, tuple] = {}
+
+        def resolve(creator_id: int, index: int) -> str:
+            h = local.get((creator_id, index))
+            if h is not None:
+                return h
+            win = windows.get(creator_id)
+            if win is None:
+                creator = self.reverse_participants[creator_id]
+                win = self.store.participant_window(creator)
+                windows[creator_id] = win
+            items, last_index = win
+            pos = index - (last_index - len(items) + 1)
+            if 0 <= pos < len(items):
+                return items[pos]
+            # Aged out of the rolling window (or unknown): fall back to
+            # the per-event store probe, which raises the same
+            # StoreError the serial path raised.
+            creator = self.reverse_participants[creator_id]
+            return self.store.participant_event(creator, index)
+
+        out: List[Event] = []
+        for wevent in wire_events:
+            wb = wevent.body
+            self_parent = ""
+            other_parent = ""
+            if wb.self_parent_index >= 0:
+                self_parent = resolve(wb.creator_id, wb.self_parent_index)
+            if wb.other_parent_index >= 0:
+                other_parent = resolve(
+                    wb.other_parent_creator_id, wb.other_parent_index)
+
+            creator = self.reverse_participants[wb.creator_id]
+            body = EventBody(
+                transactions=wb.transactions,
+                parents=[self_parent, other_parent],
+                creator=bytes.fromhex(creator[2:]),
+                timestamp=wb.timestamp,
+                index=wb.index,
+            )
+            body.self_parent_index = wb.self_parent_index
+            body.other_parent_creator_id = wb.other_parent_creator_id
+            body.other_parent_index = wb.other_parent_index
+            body.creator_id = wb.creator_id
+            ev = Event(body, r=wevent.r, s=wevent.s)
+            local[(wb.creator_id, wb.index)] = ev.hex()
+            out.append(ev)
+        return out
+
     # -- consensus pipeline ------------------------------------------------
 
     def divide_rounds(self) -> None:

@@ -28,6 +28,15 @@ class InmemStore:
         self.consensus_cache = RollingIndex(cache_size)
         self.tot_consensus_events = 0
         self.participant_events_cache = ParticipantEventsCache(cache_size, participants)
+        # Topologically-ordered per-participant Event-object windows
+        # (same rolling cadence as the hash windows above): a creator's
+        # events are inserted in self-parent-chain order, so each
+        # window is sorted by topological index and `Core.diff` can
+        # answer a gossip pull as an O(Δ) merge over delta suffixes
+        # instead of a get_event per hash plus a global re-sort.
+        self._event_obj_windows: Dict[str, RollingIndex] = {
+            pk: RollingIndex(cache_size) for pk in participants
+        }
         self.roots: Dict[str, Root] = {pk: new_base_root() for pk in participants}
         self._last_round = -1
 
@@ -55,6 +64,11 @@ class InmemStore:
         known = self.event_cache.contains(key)
         if not known:
             self.participant_events_cache.add(event.creator(), key, event.index())
+            win = self._event_obj_windows.get(event.creator())
+            if win is None:
+                win = RollingIndex(self._cache_size)
+                self._event_obj_windows[event.creator()] = win
+            win.add(event, event.index())
         self.event_cache.add(key, event)
 
     def participant_events(self, participant: str, skip: int) -> List[str]:
@@ -62,6 +76,15 @@ class InmemStore:
 
     def participant_event(self, participant: str, index: int) -> str:
         return self.participant_events_cache.get_item(participant, index)
+
+    def participant_window(self, participant: str):
+        return self.participant_events_cache.window(participant)
+
+    def participant_event_objects(self, participant: str, skip: int) -> List[Event]:
+        win = self._event_obj_windows.get(participant)
+        if win is None:
+            raise StoreError(StoreErrType.KEY_NOT_FOUND, participant)
+        return win.get(skip)
 
     def last_from(self, participant: str) -> Tuple[str, bool]:
         last = self.participant_events_cache.get_last(participant)
@@ -138,6 +161,9 @@ class InmemStore:
         self.round_cache = LRU(self._cache_size)
         self.consensus_cache = RollingIndex(self._cache_size)
         self.participant_events_cache.reset()
+        self._event_obj_windows = {
+            pk: RollingIndex(self._cache_size) for pk in self._participants
+        }
         self._last_round = -1
 
     def close(self) -> None:

@@ -7,7 +7,7 @@ reports the last index per participant id.
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Tuple
 
 from ..common import RollingIndex, StoreError, StoreErrType
 
@@ -31,6 +31,15 @@ class ParticipantEventsCache:
         if pe is None:
             raise StoreError(StoreErrType.KEY_NOT_FOUND, participant)
         return pe.get_item(index)
+
+    def window(self, participant: str) -> Tuple[List[str], int]:
+        """The live (items, last_index) rolling window — one snapshot
+        per creator lets a batch resolve from it positionally instead
+        of paying a get_item round trip per wire coordinate."""
+        pe = self.participant_events.get(participant)
+        if pe is None:
+            raise StoreError(StoreErrType.KEY_NOT_FOUND, participant)
+        return pe.get_last_window()
 
     def get_last(self, participant: str) -> str:
         pe = self.participant_events.get(participant)

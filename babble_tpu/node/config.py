@@ -45,6 +45,13 @@ class Config:
     # The reference needs no such bound because its gossip is fully
     # serialized with RunConsensus (node/node.go:467-487).
     engine_backlog_limit: int = 1024
+    # Worker pool for batched sync-ingest signature verification
+    # (docs/ingest.md): Core.sync materializes a whole sync batch, then
+    # ECDSA-checks it on a process-global pool with the core lock
+    # RELEASED, so gossip serving continues while a batch grinds.
+    # < 0 = auto (one worker per core, capped at 8); 0/1 = verify
+    # inline on the syncing thread (still outside the lock).
+    verify_workers: int = -1
     # Consensus pipeline depth for the device engine (requires
     # consensus_interval > 0). 0 = synchronous: each worker wake runs
     # dispatch + collect back to back (the host blocks on the device

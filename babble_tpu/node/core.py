@@ -9,6 +9,7 @@ node stats / the HTTP service."""
 
 from __future__ import annotations
 
+import heapq
 import logging
 import time
 from typing import Callable, Dict, List, Optional
@@ -19,6 +20,7 @@ from ..hashgraph.block import Block
 from ..hashgraph.event import Event, WireEvent
 from ..hashgraph.graph import Hashgraph
 from ..hashgraph.store import Store
+from .ingest import resolve_verify_workers, verify_events
 
 
 class Core:
@@ -33,6 +35,7 @@ class Core:
         engine_mesh: int = 0,
         engine_prewarm: bool = False,
         engine_opts: Optional[Dict] = None,
+        verify_workers: int = -1,
     ):
         self.id = id
         self.key = key
@@ -106,6 +109,7 @@ class Core:
             raise ValueError(f"unknown consensus engine {engine!r}")
         self.participants = participants
         self.reverse_participants = {pid: pk for pk, pid in participants.items()}
+        self.verify_workers = resolve_verify_workers(verify_workers)
         self.head = ""
         self.seq = -1
         self.transaction_pool: List[bytes] = []
@@ -212,38 +216,94 @@ class Core:
 
     def diff(self, known: Dict[int, int]) -> List[Event]:
         """Events we know that `known` doesn't, in topological order —
-        reference node/core.go:166-188."""
+        reference node/core.go:166-188.
+
+        O(Δ) path: each participant's rolling window is already sorted
+        by topological index (a creator's events insert in self-parent
+        chain order), so the answer is a merge over just the delta
+        suffixes (`participant_event_objects`) instead of a get_event
+        per hash plus a global re-sort. Topological indexes are unique
+        per engine, so the merge is byte-identical to the old sort."""
         t0 = time.perf_counter_ns()
-        unknown: List[Event] = []
+        chunks: List[List[Event]] = []
         for pid, ct in known.items():
             pk = self.reverse_participants[pid]
-            for ehex in self.hg.store.participant_events(pk, ct):
-                unknown.append(self.hg.store.get_event(ehex))
-        unknown.sort(key=lambda e: e.topological_index)
+            chunk = self.hg.store.participant_event_objects(pk, ct)
+            if chunk:
+                chunks.append(chunk)
+        if not chunks:
+            unknown: List[Event] = []
+        elif len(chunks) == 1:
+            unknown = chunks[0]
+        else:
+            unknown = list(
+                heapq.merge(*chunks, key=lambda e: e.topological_index))
         self._timed("diff", t0)
         return unknown
 
-    def sync(self, unknown: List[WireEvent]) -> None:
+    def sync(self, unknown: List[WireEvent], unlocked=None) -> None:
         """Insert synced events, then wrap the tx pool and the other
         party's head in a new self-event — reference node/core.go:190-230.
 
+        Batched ingest pipeline (docs/ingest.md): the batch is
+        processed as a batch, not event-by-event —
+
+          1. from_wire: materialize every wire event, resolving parent
+             coordinates against the batch itself plus one window
+             snapshot per creator (read_wire_batch) — under the lock;
+          2. verify: ECDSA-check every event that is not already in
+             the store on the shared worker pool, with the core lock
+             RELEASED via the `unlocked` seam (signature validity is a
+             pure function of the event bytes) — results are memoized
+             on the events;
+          3. insert: re-acquire the lock and run the exact serial
+             insert loop; its insert-time verify() is a memo hit, and
+             a bad signature raises at the same batch position the
+             serial path raised at.
+
         Events already in the store are SKIPPED rather than failing the
         batch: this node answers pulls and accepts pushes concurrently
-        (the core lock is released during the pull round trip), so a
-        response computed against a slightly stale known-map routinely
-        overlaps a concurrent push. Events are content-addressed, so a
-        duplicate is byte-identical and skipping is consensus-neutral —
-        whereas aborting the whole batch (the reference's behavior
-        under its fully-serialized gossip) wedges a node permanently
-        once every peer's syncs overlap."""
+        (the core lock is released during the pull round trip — and now
+        during verify), so a response computed against a slightly stale
+        known-map routinely overlaps a concurrent push. Events are
+        content-addressed, so a duplicate is byte-identical and
+        skipping is consensus-neutral — whereas aborting the whole
+        batch (the reference's behavior under its fully-serialized
+        gossip) wedges a node permanently once every peer's syncs
+        overlap. Duplicates are excluded from verification too (the
+        serial path never verified them either); events that become
+        duplicates DURING the unlocked verify window are caught by the
+        insert loop's has_event re-check."""
+        t_sync = time.perf_counter_ns()
+
+        t0 = time.perf_counter_ns()
+        events = self.hg.read_wire_batch(unknown)
+        self._timed("from_wire", t0)
+
+        t0 = time.perf_counter_ns()
+        has_event = self.hg.store.has_event
+        to_verify = [ev for ev in events if not has_event(ev.hex())]
+        if to_verify:
+            if unlocked is not None:
+                with unlocked():
+                    verify_events(to_verify, self.verify_workers)
+            else:
+                verify_events(to_verify, self.verify_workers)
+        self._timed("verify", t0)
+
         t0 = time.perf_counter_ns()
         other_head = ""
-        for k, we in enumerate(unknown):
-            ev = self.hg.read_wire_info(we)
-            if not self.hg.store.has_event(ev.hex()):
+        for k, ev in enumerate(events):
+            if not has_event(ev.hex()):
                 self.insert_event(ev, False)
-            if k == len(unknown) - 1:
+            if k == len(events) - 1:
+                # Head selection: the peer's head is the LAST event of
+                # its diff even when that event was skipped as a
+                # duplicate (its stored copy may differ in wire
+                # indexes, but the hash covers only {Body, R, S}, so
+                # the hex names the stored copy identically).
                 other_head = ev.hex()
+        self._timed("insert", t0)
 
         if len(unknown) > 0 or len(self.transaction_pool) > 0:
             new_head = Event.new(
@@ -254,7 +314,7 @@ class Core:
             )
             self.sign_and_insert_self_event(new_head)
             self.transaction_pool = []
-        self._timed("sync", t0)
+        self._timed("sync", t_sync)
 
     def add_self_event(self) -> None:
         """Wrap a non-empty tx pool in a new self-event — reference
@@ -332,9 +392,11 @@ class Core:
 
         The rebuilt store is in-memory: failover trades persistence for
         availability (a file-store node that fails over must fast-sync
-        after its next restart). Replay re-verifies every signature —
-        O(E) ECDSA — so expect seconds, not millis, on a large DAG;
-        that is the price of not trusting a failing engine's mirror."""
+        after its next restart). Replay re-checks every signature via
+        Event.verify(); events verified at original ingest carry their
+        memoized verdict (the memo lives in host memory, the same trust
+        domain as the store being replayed), so the rebuild is bounded
+        by insert/coordinate work rather than O(E) ECDSA."""
         old = self.hg
         if not hasattr(old, "dispatch_consensus"):
             return  # already on the host engine

@@ -1,0 +1,98 @@
+"""Host ingest helpers: the batched signature-verify pool.
+
+A sync batch's ECDSA checks are the dominant host cost of the gossip
+ingest path (BENCH_r05: the device engine sustains ~28k ev/s while the
+live node path delivers ~500), and none of them needs the core lock —
+signature validity is a pure function of the event bytes. `Core.sync`
+therefore materializes the whole batch first, then calls
+`verify_events` with the lock RELEASED (node's `_core_unlocked` seam),
+and only re-acquires it for the insert phase.
+
+Worker pool: one process-global ThreadPoolExecutor shared by every
+in-process node (a 16-node localhost testnet must not spawn 16 pools).
+With the `cryptography` backend (OpenSSL) each verify releases the GIL,
+so chunks run genuinely in parallel; the pure-Python fallback is
+GIL-bound but still gets the chunked path — the win there is that
+verification happens outside the core lock, so the node keeps serving
+syncs and accepting pushes while a batch grinds.
+
+Verification results are memoized on the Event (`Event.verify` caches
+`_sig_ok`), so the engine's own insert-time `verify()` re-check is a
+cache hit, and a worker raising (malformed creator point) leaves the
+memo unset — the insert loop then re-raises the same exception at the
+same batch position the serial path would have.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+_MAX_WORKERS = 8
+# Below this batch size the pool's submit/wake overhead beats any
+# parallelism — verify inline on the calling thread.
+_MIN_POOL_BATCH = 8
+
+_pool: Optional[ThreadPoolExecutor] = None
+_pool_size = 0
+_pool_lock = threading.Lock()
+
+
+def default_verify_workers() -> int:
+    """Auto pool size: one worker per core, capped — verification is
+    CPU-bound, and past the cap coordination overhead wins."""
+    return max(1, min(_MAX_WORKERS, os.cpu_count() or 1))
+
+
+def resolve_verify_workers(verify_workers: int) -> int:
+    """Config knob semantics: < 0 = auto (core-count), 0/1 = inline
+    serial, n > 1 = a pool of n."""
+    if verify_workers < 0:
+        return default_verify_workers()
+    return min(verify_workers, _MAX_WORKERS) or 1
+
+
+def _get_pool(workers: int) -> ThreadPoolExecutor:
+    global _pool, _pool_size
+    with _pool_lock:
+        if _pool is None or _pool_size < workers:
+            old = _pool
+            _pool = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="babble-verify")
+            _pool_size = workers
+            if old is not None:
+                old.shutdown(wait=False)
+        return _pool
+
+
+def _verify_chunk(events) -> None:
+    for ev in events:
+        try:
+            ev.verify()  # memoizes _sig_ok on the event
+        except Exception:  # noqa: BLE001
+            # Leave the memo unset: the insert loop's own verify() will
+            # re-raise the identical exception at the serial path's
+            # position instead of this worker's.
+            pass
+
+
+def verify_events(events: List, workers: int) -> None:
+    """Populate every event's signature memo, chunked across the shared
+    pool. Returns nothing: outcomes (ok / bad / raising) are delivered
+    through `Event.verify` exactly as the serial path delivers them."""
+    n = len(events)
+    if n == 0:
+        return
+    if workers <= 1 or n < _MIN_POOL_BATCH:
+        _verify_chunk(events)
+        return
+    pool = _get_pool(workers)
+    chunk = -(-n // workers)  # ceil
+    futures = [
+        pool.submit(_verify_chunk, events[i:i + chunk])
+        for i in range(0, n, chunk)
+    ]
+    for f in futures:
+        f.result()

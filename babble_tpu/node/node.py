@@ -77,6 +77,7 @@ class Node:
             engine_mesh=getattr(conf, "engine_mesh", 0),
             engine_prewarm=getattr(conf, "engine_prewarm", False),
             engine_opts=getattr(conf, "engine_opts", None),
+            verify_workers=getattr(conf, "verify_workers", -1),
         )
         self.core_lock = threading.Lock()
         # At most two gossip rounds in flight (see _babble).
@@ -513,8 +514,11 @@ class Node:
         — reference node/node.go:467-487. With consensus_interval > 0
         the pass moves to the dedicated consensus worker: syncs are
         pure wire-speed inserts and the engine drains several syncs per
-        (device) pass."""
-        self.core.sync(events)
+        (device) pass. The unlocked seam lets Core.sync release the
+        core lock around the batch signature verify (docs/ingest.md):
+        this node keeps answering pulls and accepting pushes while the
+        verify pool grinds the batch."""
+        self.core.sync(events, unlocked=self._core_unlocked)
         if self.conf.consensus_interval <= 0:
             self.core.run_consensus()
 

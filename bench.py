@@ -318,17 +318,20 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
     4-node docker demo steady state (reference docs/usage.rst:31-34)."""
     import threading
 
-    import jax as _jax
+    if engine == "tpu":
+        import jax as _jax
 
-    # The persistent compile cache is the product default (cli.py
-    # enables it for every tpu-engine node); without it the warmup
-    # re-pays every engine-shape compile and the window lands in the
-    # immature phase. child() also sets this, but the function must be
-    # self-sufficient for standalone calls (verification drives import
-    # bench and call it directly).
-    os.makedirs(CACHE_DIR, exist_ok=True)
-    _jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
-    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        # The persistent compile cache is the product default (cli.py
+        # enables it for every tpu-engine node); without it the warmup
+        # re-pays every engine-shape compile and the window lands in
+        # the immature phase. child() also sets this, but the function
+        # must be self-sufficient for standalone calls (verification
+        # drives import bench and call it directly). Host-engine runs
+        # never touch JAX, so the --node-smoke CI path stays light.
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        _jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0)
 
     from babble_tpu import crypto
     from babble_tpu.hashgraph import InmemStore
@@ -434,12 +437,22 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
             for ph, ent in list(nd.core.phase_ns.items()):
                 tot[ph] = tot.get(ph, 0) + ent[1]
         phases: dict = {}
+        # The ingest stages (docs/ingest.md) are sub-spans of `sync`,
+        # so they get their own share denominator (the sync wall) and
+        # stay out of the top-level split, like the engine_* subset of
+        # consensus_dispatch/collect.
+        ingest = {ph: v for ph, v in tot.items()
+                  if ph in ("from_wire", "verify", "insert")}
         top = {ph: v for ph, v in tot.items()
-               if not ph.startswith("engine_")}
+               if not ph.startswith("engine_") and ph not in ingest}
         if top:
             s = sum(top.values())
             phases["phase_share"] = {
                 ph: round(v / s, 3) for ph, v in sorted(top.items())}
+        if ingest and tot.get("sync"):
+            phases["ingest_phase_share"] = {
+                ph: round(v / tot["sync"], 3)
+                for ph, v in sorted(ingest.items())}
         eng_t = {ph[len("engine_"):]: v for ph, v in tot.items()
                  if ph.startswith("engine_") and ph != "engine_overlap"}
         if eng_t:
@@ -468,6 +481,35 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
     if m % 2:
         return rates[m // 2], phases
     return (rates[m // 2 - 1] + rates[m // 2]) / 2.0, phases
+
+
+def node_smoke():
+    """Host-ingest microbench for CI: a 3-node in-mem host-engine
+    gossip testnet (fixed seeds, no TPU, no JAX import) measured for
+    ~20s, emitting one JSON line with `node_events_per_s` so host-path
+    regressions are visible per-PR in the job log. Exit code is 0
+    whenever a measurement was made — the number is recorded, not
+    gated (CI machines vary too much for a hard threshold)."""
+    payload = {
+        "metric": "node_events_per_s_smoke",
+        "unit": "events/s",
+        "nodes": 3,
+        "engine": "host",
+    }
+    try:
+        eps, phases = node_testnet_events_per_sec(
+            engine="host", n_nodes=3, warm_s=8.0, window_s=12.0,
+            interval=0.0, warm_gate_events=200, windows=1)
+        payload["node_events_per_s"] = round(eps, 1)
+        payload["node_phase_share"] = phases.get("phase_share")
+        payload["node_ingest_phase_share"] = phases.get(
+            "ingest_phase_share")
+    except Exception as exc:  # noqa: BLE001
+        payload["error"] = str(exc)
+        _emit(payload)
+        return 1
+    _emit(payload)
+    return 0
 
 
 def child():
@@ -714,6 +756,8 @@ def child():
                 payload["node_vs_ref_docker"] = round(
                     node_eps / ref_docker, 2)
                 payload["node_phase_share"] = node_ph.get("phase_share")
+                payload["node_ingest_phase_share"] = node_ph.get(
+                    "ingest_phase_share")
                 _emit(payload)
             except Exception as exc:  # noqa: BLE001
                 log(f"  node host stage failed: {exc}")
@@ -905,5 +949,7 @@ def child():
 if __name__ == "__main__":
     if "--child" in sys.argv:
         child()
+    elif "--node-smoke" in sys.argv:
+        sys.exit(node_smoke())
     else:
         main()

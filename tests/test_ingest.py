@@ -1,0 +1,408 @@
+"""Host ingest fast path (docs/ingest.md): batched wire materialize /
+pooled signature verify outside the core lock / serial-identical insert,
+the Event marshal-hash cache-invalidation contract, and the O(Δ) diff
+merge."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from babble_tpu import crypto
+from babble_tpu.hashgraph.event import Event, WireEvent, event_from_json_obj
+from babble_tpu.hashgraph.graph import InsertError
+
+from test_node import init_cores, make_nodes, synchronize_cores
+
+
+# ------------------------------------------------------------ event caches
+
+
+def test_event_marshal_and_hash_are_cached_and_exact():
+    """The memoized encodings must be byte-identical to a fresh
+    marshal (consensus order hangs off these bytes)."""
+    key = crypto.key_from_seed(42)
+    ev = Event.new([b"tx"], ["", ""], crypto.pub_key_bytes(key), 0)
+    ev.sign(key)
+
+    m1 = ev.marshal()
+    assert ev.marshal() is m1  # memo hit
+    # Round-trip through the JSON form and re-marshal: byte-identical.
+    clone = event_from_json_obj(json.loads(m1))
+    assert clone.marshal() == m1
+    assert clone.hex() == ev.hex()
+    # Body bytes likewise.
+    assert ev.body.marshal() == clone.body.marshal()
+    assert ev.body.hash() == clone.body.hash()
+
+
+def test_event_sign_invalidates_identity_but_not_body():
+    key = crypto.key_from_seed(43)
+    ev = Event.new([b"tx"], ["", ""], crypto.pub_key_bytes(key), 0)
+    ev.sign(key)
+    h1, m1, bh1 = ev.hex(), ev.marshal(), ev.body.hash()
+    assert ev.verify()
+
+    # Re-sign with a DIFFERENT key: R/S change, so the event hash and
+    # marshal must be recomputed — and the memoized verify verdict must
+    # flip (the new signature does not match the creator in the body).
+    other = crypto.key_from_seed(44)
+    ev.sign(other)
+    assert ev.hex() != h1
+    assert ev.marshal() != m1
+    assert not ev.verify()
+    # The body was untouched: its memo must still be valid and equal.
+    assert ev.body.hash() == bh1
+
+
+def test_event_mutation_after_hashing_requires_invalidate():
+    """Regression for the cache-invalidation contract: a by-hand body
+    mutation after hashing goes stale until invalidate(); after
+    invalidate() every memo (body bytes, event bytes, hash, hex,
+    signature verdict) recomputes from the mutated fields."""
+    key = crypto.key_from_seed(45)
+    ev = Event.new([b"tx"], ["", ""], crypto.pub_key_bytes(key), 0)
+    ev.sign(key)
+    h1, bh1 = ev.hex(), ev.body.hash()
+    assert ev.verify()
+
+    ev.body.index = 7  # by-hand mutation, no invalidate yet
+    assert ev.hex() == h1  # memo is (documented as) stale
+
+    ev.invalidate()
+    assert ev.hex() != h1
+    assert ev.body.hash() != bh1
+    assert not ev.verify()  # signature covered the OLD body bytes
+
+    # Restore and re-invalidate: memos must converge back.
+    ev.body.index = 0
+    ev.invalidate()
+    assert ev.hex() == h1
+    assert ev.body.hash() == bh1
+    assert ev.verify()
+
+
+def test_set_wire_info_refreshes_wire_form_only():
+    key = crypto.key_from_seed(46)
+    ev = Event.new([b"tx"], ["", ""], crypto.pub_key_bytes(key), 0)
+    ev.sign(key)
+    h1 = ev.hex()
+    ev.set_wire_info(3, 1, 5, 2)
+    w1 = ev.to_wire()
+    assert ev.to_wire() is w1  # memo hit
+    assert (w1.body.self_parent_index, w1.body.other_parent_creator_id,
+            w1.body.other_parent_index, w1.body.creator_id) == (3, 1, 5, 2)
+
+    ev.set_wire_info(4, 0, 6, 2)
+    w2 = ev.to_wire()
+    assert w2 is not w1
+    assert (w2.body.self_parent_index, w2.body.other_parent_index) == (4, 6)
+    # Wire ints are unexported in Go: the identity must NOT move.
+    assert ev.hex() == h1
+
+
+# ------------------------------------------------------------ batched sync
+
+
+def _ping_pong(cores, rounds, payload=b"x"):
+    for k in range(rounds):
+        synchronize_cores(cores, 0, 1, [payload + str(k).encode()])
+        synchronize_cores(cores, 1, 0)
+
+
+def test_batched_sync_matches_serial_reference():
+    """The batch pipeline (read_wire_batch + pooled verify + insert)
+    must land the exact store state the serial per-event loop lands."""
+    cores = init_cores(3)
+    _ping_pong(cores, 6)
+
+    stale = {pid: -1 for pid in cores[2].known()}
+    diff = cores[0].diff(stale)
+    wire = cores[0].to_wire(diff)
+    assert len(wire) > 10
+    expected_other_head = diff[-1].hex()
+
+    # Batch path.
+    cores[2].sync(wire)
+    batch_known = cores[2].known()
+    assert cores[2].get_head().other_parent() == expected_other_head
+
+    # Serial reference: the same playbook on fresh cores (hashes differ
+    # — timestamps — but the per-participant index frontier the serial
+    # loop lands is deterministic and must match exactly).
+    ref = init_cores(3)
+    _ping_pong(ref, 6)
+    wire_ref = ref[0].to_wire(ref[0].diff(stale))
+    assert len(wire_ref) == len(wire)
+    for we in wire_ref:
+        ev = ref[2].hg.read_wire_info(we)
+        if not ref[2].hg.store.has_event(ev.hex()):
+            ref[2].insert_event(ev, False)
+    self_pid = ref[2].participants[ref[2].hex_id()]
+    for pid, idx in ref[2].known().items():
+        if pid != self_pid:
+            assert batch_known[pid] == idx
+    # The batch core additionally wrapped the sync in a self-event.
+    assert batch_known[self_pid] == ref[2].known()[self_pid] + 1
+
+
+def test_sync_head_selection_with_duplicate_tail():
+    """Satellite pin: `other_head` must name the LAST wire event of the
+    batch even when that event is skipped as a duplicate (overlapping
+    pushes/pulls routinely deliver a batch whose tail already landed,
+    and whose stored copy may differ in wire indexes — the hash covers
+    only {Body, R, S}, so the duplicate's hex still names the stored
+    copy), and the follow-up self-event must insert cleanly against
+    it."""
+    cores = init_cores(2)
+    synchronize_cores(cores, 0, 1, [b"a"])
+    synchronize_cores(cores, 1, 0)
+
+    stale = {pid: -1 for pid in cores[1].known()}
+    wire = cores[0].to_wire(cores[0].diff(stale))
+    expected_head = None
+
+    # First overlap push: inserts whatever was missing.
+    cores[1].sync(wire)
+    # Second identical push: EVERY event is now a duplicate (fresh
+    # WireEvent wrappers so the sender's memoized wire forms stay
+    # untouched).
+    dup = [
+        WireEvent(we.body, int(we.r), int(we.s))
+        for we in wire
+    ]
+    last = cores[1].hg.read_wire_batch(dup)[-1]
+    expected_head = last.hex()
+    assert cores[1].hg.store.has_event(expected_head)
+
+    before_seq = cores[1].seq
+    cores[1].sync(dup)  # all duplicates; must not raise
+    assert cores[1].seq == before_seq + 1
+    head = cores[1].get_head()
+    assert head.other_parent() == expected_head
+
+
+def test_batch_verify_failure_matches_serial_outcome():
+    """One bad signature inside a 100-event batch: the prefix before
+    the bad event inserts, the bad event raises the serial path's
+    InsertError at the same position, nothing after it lands, and the
+    store stays consistent (a clean retry batch applies)."""
+    cores = init_cores(3)
+    _ping_pong(cores, 50)
+
+    stale = {pid: -1 for pid in cores[2].known()}
+    diff = cores[0].diff(stale)
+    wire = cores[0].to_wire(diff)
+    assert len(wire) >= 100
+    bad_at = len(wire) // 2
+    # Corrupt the signature of one mid-batch event on a COPY (the
+    # originals are memoized on the sender's events).
+    tampered = list(wire)
+    tampered[bad_at] = WireEvent(
+        wire[bad_at].body, int(wire[bad_at].r) ^ 1, int(wire[bad_at].s))
+
+    head_before = cores[2].head
+    seq_before = cores[2].seq
+    with pytest.raises(InsertError, match="Invalid signature"):
+        cores[2].sync(tampered)
+
+    # Serial reference: replay the same tampered batch event-by-event.
+    ref = init_cores(3)
+    _ping_pong(ref, 50)
+    ref_wire = list(ref[0].to_wire(ref[0].diff(stale)))
+    ref_wire[bad_at] = WireEvent(
+        ref_wire[bad_at].body, int(ref_wire[bad_at].r) ^ 1,
+        int(ref_wire[bad_at].s))
+    with pytest.raises(InsertError, match="Invalid signature"):
+        for we in ref_wire:
+            ev = ref[2].hg.read_wire_info(we)
+            if not ref[2].hg.store.has_event(ev.hex()):
+                ref[2].insert_event(ev, False)
+
+    # Identical damage: same per-participant tips, no self-event, head
+    # untouched.
+    assert cores[2].known() == ref[2].known()
+    assert cores[2].head == head_before
+    assert cores[2].seq == seq_before
+
+    # Store left consistent: the clean batch still applies fully.
+    cores[2].sync(wire)
+    for pid, idx in cores[0].known().items():
+        if pid != cores[2].participants[cores[2].hex_id()]:
+            assert cores[2].known()[pid] == idx
+
+
+def test_bad_push_feeds_breaker_same_as_serial():
+    """A tampered eager-sync batch must surface as a failed push to the
+    sender — the outcome the peer's circuit breaker is fed — exactly
+    like the serial path's per-event failure did."""
+    from babble_tpu.net.transport import EagerSyncRequest, RPC
+
+    nodes = make_nodes(2, "inmem")
+    try:
+        synchronize_cores([nodes[0].core, nodes[1].core], 0, 1, [b"t"])
+        stale = {pid: -1 for pid in nodes[0].core.known()}
+        wire = list(nodes[1].core.to_wire(nodes[1].core.diff(stale)))
+        # Find a non-duplicate tail event to corrupt.
+        tampered = wire[:-1] + [
+            WireEvent(wire[-1].body, int(wire[-1].r) ^ 1, int(wire[-1].s))]
+
+        rpc = RPC(EagerSyncRequest(nodes[1].id, tampered))
+        nodes[0]._process_rpc(rpc)
+        out = rpc.resp_chan.get(timeout=2.0)
+        assert out.error is not None
+        assert out.response.success is False
+    finally:
+        for n in nodes:
+            n.shutdown()
+
+
+# ------------------------------------------------ verify outside the lock
+
+
+def test_verify_runs_outside_core_lock(monkeypatch):
+    """Acceptance pin: while a sync batch's signature verification is
+    in flight, the core lock is free — a concurrent thread can take it
+    and make progress (serve known(), accept an insert)."""
+    from babble_tpu.net.transport import EagerSyncRequest, RPC
+    import babble_tpu.node.core as core_mod
+
+    nodes = make_nodes(2, "inmem")
+    started = threading.Event()
+    release = threading.Event()
+    real_verify = core_mod.verify_events
+
+    def blocking_verify(events, workers):
+        started.set()
+        assert release.wait(timeout=10.0), "verify window never released"
+        real_verify(events, workers)
+
+    monkeypatch.setattr(core_mod, "verify_events", blocking_verify)
+    try:
+        # Something for node0 to ingest from node1.
+        nodes[1].core.add_transactions([b"payload"])
+        nodes[1].core.add_self_event()
+        known0 = nodes[0].core.known()
+        wire = nodes[1].core.to_wire(nodes[1].core.diff(known0))
+        assert wire
+
+        rpc = RPC(EagerSyncRequest(nodes[1].id, wire))
+        t = threading.Thread(
+            target=nodes[0]._process_rpc, args=(rpc,), daemon=True)
+        t.start()
+        assert started.wait(timeout=10.0), "verify never started"
+
+        # The verify batch is in flight — the core lock must be free.
+        got = nodes[0].core_lock.acquire(timeout=2.0)
+        assert got, "core lock held during signature verification"
+        try:
+            # Concurrent sync progress under the lock.
+            snapshot = nodes[0].core.known()
+            assert snapshot is not None
+        finally:
+            nodes[0].core_lock.release()
+
+        release.set()
+        out = rpc.resp_chan.get(timeout=10.0)
+        t.join(timeout=5.0)
+        assert out.error is None
+        assert out.response.success is True
+        # The batch actually landed, and the ingest stage timers ran.
+        for phase in ("from_wire", "verify", "insert", "sync"):
+            assert nodes[0].core.phase_ns[phase][2] >= 1, phase
+        stats = nodes[0].get_stats()
+        assert "time_verify_ns" in stats
+    finally:
+        release.set()
+        for n in nodes:
+            n.shutdown()
+
+
+# ------------------------------------------------------------- O(Δ) diff
+
+
+def test_diff_merge_matches_fetch_and_sort():
+    """The per-participant-suffix merge must reproduce the old
+    implementation (get_event per hash + global topo sort) exactly."""
+    cores = init_cores(3)
+    for k in range(5):
+        synchronize_cores(cores, 0, 1, [b"p" + bytes([k])])
+        synchronize_cores(cores, 1, 2)
+        synchronize_cores(cores, 2, 0)
+
+    for known in (
+        {pid: -1 for pid in cores[0].known()},
+        cores[1].known(),
+        cores[2].known(),
+    ):
+        got = [e.hex() for e in cores[0].diff(known)]
+        want = []
+        for pid, ct in known.items():
+            pk = cores[0].reverse_participants[pid]
+            for ehex in cores[0].hg.store.participant_events(pk, ct):
+                want.append(cores[0].hg.store.get_event(ehex))
+        want.sort(key=lambda e: e.topological_index)
+        assert got == [e.hex() for e in want]
+
+
+def test_file_store_participant_event_objects_falls_back_to_db(tmp_path):
+    """A freshly reloaded FileStore has empty rolling windows; the
+    O(Δ) object feed must serve the suffix from sqlite with topological
+    indexes intact."""
+    from babble_tpu.hashgraph import FileStore, Hashgraph
+
+    keys = [crypto.key_from_seed(7000 + i) for i in range(2)]
+    pubs = [crypto.pub_key_bytes(k) for k in keys]
+    participants = {"0x" + p.hex().upper(): i for i, p in enumerate(pubs)}
+    path = str(tmp_path / "store.db")
+    store = FileStore(participants, 100, path)
+    hg = Hashgraph(participants, store)
+
+    heads = {0: "", 1: ""}
+    for i in range(4):
+        c = i % 2
+        ev = Event.new([b"t%d" % i], [heads[c], heads[1 - c]],
+                       pubs[c], i // 2)
+        ev.sign(keys[c])
+        hg.insert_event(ev, True)
+        heads[c] = ev.hex()
+    store.close()
+
+    reloaded = FileStore.load(100, path)
+    for pk in participants:
+        objs = reloaded.participant_event_objects(pk, -1)
+        assert [e.hex() for e in objs] == reloaded.participant_events(pk, -1)
+        assert all(
+            a.topological_index < b.topological_index
+            for a, b in zip(objs, objs[1:]))
+    reloaded.close()
+
+
+def test_read_wire_batch_resolves_in_batch_parents():
+    """A batch's later events name earlier ones as parents; the batch
+    materializer must resolve those WITHOUT any store insert in
+    between, identically to the interleaved serial path. Core 2 has
+    never seen cores 0/1's chain, so nearly every parent coordinate in
+    the batch points into the batch itself."""
+    cores = init_cores(3)
+    synchronize_cores(cores, 0, 1, [b"a"])
+    synchronize_cores(cores, 1, 0, [b"b"])
+    synchronize_cores(cores, 0, 1, [b"c"])
+
+    known2 = cores[2].known()
+    wire = cores[0].to_wire(cores[0].diff(known2))
+    assert len(wire) >= 4
+
+    # Materialize first (read_wire_batch does not touch the store)...
+    batch = cores[2].hg.read_wire_batch(wire)
+    # ...then run the interleaved serial path on the SAME core.
+    serial = []
+    for we in wire:
+        ev = cores[2].hg.read_wire_info(we)
+        serial.append(ev)
+        if not cores[2].hg.store.has_event(ev.hex()):
+            cores[2].insert_event(ev, False)
+    assert [e.hex() for e in batch] == [e.hex() for e in serial]
+    assert [e.body.parents for e in batch] == [e.body.parents for e in serial]

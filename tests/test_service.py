@@ -42,6 +42,19 @@ def test_stats_endpoint():
             assert {"failures", "successes", "trips",
                     "retry_in"} <= set(state)
 
+        # per-phase timers now cover the host ingest stages too
+        # (docs/ingest.md): from_wire / verify / insert ride under the
+        # sync wall in /debug/phases.
+        with urllib.request.urlopen(
+            f"http://{service.addr}/debug/phases", timeout=2
+        ) as r:
+            assert r.status == 200
+            ph = json.loads(r.read())["phases"]
+        for stage in ("sync", "from_wire", "verify", "insert"):
+            assert stage in ph, stage
+            assert ph[stage]["calls"] >= 1
+            assert ph[stage]["total_ns"] >= 0
+
         # live device profiling (reference mounts pprof on the same mux,
         # cmd/babble/main.go:12)
         with urllib.request.urlopen(
