@@ -3,7 +3,7 @@
 # counterpart of the reference's release build (scripts/dist.sh).
 PY ?= python
 
-.PHONY: test test-fast bench demo conf run bombard watch stop dist
+.PHONY: test test-fast test-crash bench demo conf run bombard watch stop dist
 
 dist:
 	$(PY) -m build
@@ -13,6 +13,9 @@ test:
 
 test-fast:
 	$(PY) -m pytest tests/ -q -m "not slow"
+
+test-crash:
+	$(PY) -m pytest tests/test_crash.py tests/test_durability.py -q
 
 bench:
 	$(PY) bench.py

@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import signal
 import sys
 
 from . import __version__, crypto
@@ -20,7 +21,7 @@ from .crypto.pem import PemKey, generate_pem_key
 from .hashgraph import FileStore, InmemStore
 from .net import JSONPeers, TCPTransport, sort_peers_by_pub_key
 from .node import Config, Node
-from .proxy import InmemAppProxy, SocketAppProxy
+from .proxy import FileAppProxy, InmemAppProxy, SocketAppProxy
 from .service import Service
 
 DEFAULT_NODE_ADDR = "127.0.0.1:1337"
@@ -89,6 +90,7 @@ def cmd_run(args) -> int:
         sync_limit=args.sync_limit,
         store_type=args.store,
         store_path=args.store_path or os.path.join(datadir, "store.db"),
+        store_sync=args.store_sync,
         engine=args.engine,
         engine_mesh=args.engine_mesh,
         consensus_interval=(
@@ -109,18 +111,29 @@ def cmd_run(args) -> int:
     needs_bootstrap = False
     if conf.store_type == "file":
         if os.path.exists(conf.store_path):
-            store = FileStore.load(conf.cache_size, conf.store_path)
+            # --bootstrap is the explicit Go-reference spelling; an
+            # existing database implies it either way (the create path
+            # refuses populated files).
+            store = FileStore.load(
+                conf.cache_size, conf.store_path, sync=conf.store_sync)
             needs_bootstrap = True
         else:
-            store = FileStore(pmap, conf.cache_size, conf.store_path)
+            store = FileStore(
+                pmap, conf.cache_size, conf.store_path, sync=conf.store_sync)
     else:
+        if args.bootstrap:
+            print("error: --bootstrap requires --store file",
+                  file=sys.stderr)
+            return 1
         store = InmemStore(pmap, conf.cache_size)
 
     trans = TCPTransport(
         args.node_addr, max_pool=args.max_pool, timeout=conf.tcp_timeout
     )
 
-    if args.no_client:
+    if args.journal:
+        proxy = FileAppProxy(args.journal)
+    elif args.no_client:
         proxy = InmemAppProxy()
     else:
         proxy = SocketAppProxy(
@@ -133,9 +146,27 @@ def cmd_run(args) -> int:
     service = Service(args.service_addr, node)
     service.serve_async()
     logger.info(
-        "node %d on %s (service %s, store %s)",
+        "node %d on %s (service %s, store %s, sync %s)",
         node_id, trans.local_addr(), service.addr, conf.store_type,
+        conf.store_sync,
     )
+
+    # Graceful shutdown on SIGTERM/SIGINT: the handler only requests
+    # the state change — run() observes it and returns, and the
+    # finally below does the real teardown (drain the in-flight
+    # consensus pass, deliver queued blocks, flush/commit the store,
+    # close it). Doing the teardown inside the signal frame would race
+    # the main loop; before this handler a SIGTERM simply killed the
+    # process and could drop a staged batch on the floor.
+    def request_shutdown(signum, _frame):
+        logger.info("signal %d: shutting down", signum)
+        from .node.state import NodeState
+
+        node.state.set_state(NodeState.SHUTDOWN)
+        node._shutdown.set()
+
+    signal.signal(signal.SIGTERM, request_shutdown)
+    signal.signal(signal.SIGINT, request_shutdown)
 
     try:
         node.run(gossip=True)
@@ -144,6 +175,9 @@ def cmd_run(args) -> int:
     finally:
         node.shutdown()
         service.close()
+        close = getattr(proxy, "close", None)
+        if close is not None:
+            close()
     return 0
 
 
@@ -184,6 +218,24 @@ def build_parser() -> argparse.ArgumentParser:
                     help="store backend")
     rn.add_argument("--store_path", default="",
                     help="path of the file store database")
+    rn.add_argument("--store_sync", default="batch",
+                    choices=["always", "batch", "off"],
+                    help="file store fsync policy: always = fsync every "
+                         "commit (power-loss safe), batch = fsync at WAL "
+                         "checkpoints (kill-safe, the default), off = no "
+                         "fsyncs (fastest, still atomic under process "
+                         "death)")
+    rn.add_argument("--bootstrap", action="store_true",
+                    help="recover from an existing file store database "
+                         "(replay the event log, resume consensus "
+                         "exactly-once); implied when --store_path "
+                         "already exists")
+    rn.add_argument("--journal", default="",
+                    help="run with a journal app proxy: committed "
+                         "blocks append to this fsynced JSONL file "
+                         "with exactly-once restart dedupe (crash "
+                         "harness / audit mode; overrides --no_client "
+                         "and the socket client)")
     rn.add_argument("--engine", default="host", choices=["host", "tpu"],
                     help="consensus engine: reference-semantics host "
                          "driver or the batched device pipeline")

@@ -8,7 +8,20 @@ the database; reads fall back to the database when the cache misses
 feeds `Hashgraph.bootstrap()` replay.
 
 sqlite3 is the idiomatic stand-in for the embedded Badger KV store: in
-the standard library, single-file, crash-safe."""
+the standard library, single-file, crash-safe.
+
+Crash consistency (docs/robustness.md "Crash recovery"): the database
+runs in WAL mode and writes are grouped into explicit transactions via
+the Store batch seam (`begin_batch`/`commit_batch`/`rollback_batch`).
+One sync batch's event inserts, and one consensus pass's round/witness/
+block writes, each land atomically — a process killed at any
+instruction leaves either all of a batch or none of it visible after
+reload. A `meta` table carries the schema version, the durable
+delivered-block anchor (`last_committed_block`, exactly-once app
+delivery across restarts) and the consensus anchor (the highest round
+written by a COMPLETE consensus pass; rounds above it found at load
+time are a torn tail from a pre-transactional writer and are
+discarded)."""
 
 from __future__ import annotations
 
@@ -16,14 +29,62 @@ import json
 import os
 import sqlite3
 import threading
+import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..common import StoreError, StoreErrType
+from ..gojson import Timestamp, ZERO_TIME
 from .block import Block
-from .event import Event, event_from_json_obj
+from .event import Event, EventCoordinates, event_from_json_obj
 from .inmem_store import InmemStore
 from .root import Root, new_base_root
 from .round_info import RoundInfo, RoundEvent, Trilean
+
+SCHEMA_VERSION = 2
+
+# store_sync policy -> sqlite synchronous level. In WAL mode:
+#   always: fsync the WAL on every commit (survives power loss);
+#   batch:  fsync only at WAL checkpoints (survives process kill —
+#           commits are atomic either way, WAL frames are checksummed);
+#   off:    no fsyncs at all (fastest; still atomic under kill -9
+#           because the OS page cache survives the process).
+_SYNC_PRAGMA = {"always": "FULL", "batch": "NORMAL", "off": "OFF"}
+
+
+def _annotations_to_json(ev: Event) -> str:
+    """Runtime annotations that are NOT part of the canonical Go-JSON
+    event bytes (unexported in the reference): wire coordinates, the
+    per-participant ancestry vectors, and consensus marks. Without
+    them an event served from the sqlite fallback after LRU eviction
+    is unusable as a parent (empty last_ancestors crashes coordinate
+    init) and silently breaks strongly_see (zip over an empty vector
+    counts zero)."""
+    return json.dumps({
+        "w": [ev.body.self_parent_index, ev.body.other_parent_creator_id,
+              ev.body.other_parent_index, ev.body.creator_id],
+        "la": [[c.index, c.hash] for c in ev.last_ancestors],
+        "fd": [[c.index, c.hash] for c in ev.first_descendants],
+        "rr": ev.round_received,
+        "cts": ev.consensus_timestamp.ns,
+    })
+
+
+def _annotations_from_json(ev: Event, data: Optional[str]) -> Event:
+    if not data:
+        return ev  # legacy row (pre-annotation schema)
+    obj = json.loads(data)
+    w = obj.get("w")
+    if w:
+        ev.set_wire_info(w[0], w[1], w[2], w[3])
+    ev.last_ancestors = [
+        EventCoordinates(hash=h, index=i) for i, h in obj.get("la", [])]
+    ev.first_descendants = [
+        EventCoordinates(hash=h, index=i) for i, h in obj.get("fd", [])]
+    ev.round_received = obj.get("rr")
+    cts = obj.get("cts")
+    if cts is not None and cts != ZERO_TIME.ns:
+        ev.consensus_timestamp = Timestamp(cts)
+    return ev
 
 
 def _round_to_json(info: RoundInfo) -> str:
@@ -56,15 +117,31 @@ class FileStore:
         cache_size: int,
         path: str,
         create: bool = True,
+        sync: str = "batch",
     ):
+        if sync not in _SYNC_PRAGMA:
+            raise ValueError(f"unknown store_sync policy {sync!r}")
         self.path = path
+        self.sync = sync
         self._lock = threading.RLock()
+        self._closed = False
+        # Batch protocol state: while depth > 0 per-statement commits
+        # are suppressed and every write joins one sqlite transaction,
+        # committed (or rolled back) at the outermost commit_batch.
+        self._batch_depth = 0
+        self._rounds_dirty = False
+        # Durable-commit observability (fsync proxy: wall time of the
+        # sqlite COMMIT, which is where the WAL write+fsync happens).
+        self.fsync_count = 0
+        self.fsync_total_ns = 0
+        self.fsync_last_ns = 0
         exists = os.path.exists(path)
         if not exists and not create:
             raise StoreError(StoreErrType.KEY_NOT_FOUND, path)
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
-        self._db.execute("PRAGMA synchronous=NORMAL")
+        self._db.execute(f"PRAGMA synchronous={_SYNC_PRAGMA[sync]}")
+        legacy = exists and not self._has_meta_table()
         self._init_schema()
 
         if exists and create:
@@ -81,16 +158,25 @@ class FileStore:
                 )
         if exists and not create:
             participants = self._db_participants()
+            self._recover(legacy)
         elif participants:
             self._db_set_participants(participants)
         self.inmem = InmemStore(participants, cache_size)
+        self.inmem.set_last_committed_block(
+            self._get_meta_int("last_committed_block", -1))
         self._participants = participants
 
     @classmethod
-    def load(cls, cache_size: int, path: str) -> "FileStore":
+    def load(cls, cache_size: int, path: str, sync: str = "batch") -> "FileStore":
         """Reopen an existing store, reading participants from disk —
         reference LoadBadgerStore (badger_store.go:54-83)."""
-        return cls({}, cache_size, path, create=False)
+        return cls({}, cache_size, path, create=False, sync=sync)
+
+    def _has_meta_table(self) -> bool:
+        row = self._db.execute(
+            "SELECT 1 FROM sqlite_master WHERE type='table' AND name='meta'"
+        ).fetchone()
+        return row is not None
 
     def _init_schema(self) -> None:
         with self._lock:
@@ -102,7 +188,8 @@ class FileStore:
                     creator TEXT NOT NULL,
                     idx INTEGER NOT NULL,
                     topo INTEGER NOT NULL,
-                    data TEXT NOT NULL
+                    data TEXT NOT NULL,
+                    annotations TEXT
                 );
                 CREATE INDEX IF NOT EXISTS events_by_participant
                     ON events (creator, idx);
@@ -114,9 +201,163 @@ class FileStore:
                     pubkey TEXT PRIMARY KEY, id INTEGER NOT NULL);
                 CREATE TABLE IF NOT EXISTS roots (
                     pubkey TEXT PRIMARY KEY, data TEXT NOT NULL);
+                CREATE TABLE IF NOT EXISTS meta (
+                    key TEXT PRIMARY KEY, value TEXT NOT NULL);
                 """
             )
+            # Schema-v1 migration: the events table predates the
+            # annotations column (CREATE IF NOT EXISTS won't add it).
+            cols = [r[1] for r in self._db.execute(
+                "PRAGMA table_info(events)").fetchall()]
+            if "annotations" not in cols:
+                self._db.execute(
+                    "ALTER TABLE events ADD COLUMN annotations TEXT")
+            self._db.execute(
+                "INSERT OR IGNORE INTO meta VALUES ('schema_version', ?)",
+                (str(SCHEMA_VERSION),),
+            )
             self._db.commit()
+
+    # -- meta / anchors ----------------------------------------------------
+
+    def _get_meta_int(self, key: str, default: int) -> int:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT value FROM meta WHERE key = ?", (key,)
+            ).fetchone()
+        return int(row[0]) if row is not None else default
+
+    def _set_meta(self, key: str, value: str) -> None:
+        # Joins the open transaction when a batch is in flight.
+        self._db.execute(
+            "INSERT OR REPLACE INTO meta VALUES (?, ?)", (key, value))
+
+    def schema_version(self) -> int:
+        return self._get_meta_int("schema_version", 1)
+
+    def _recover(self, legacy: bool) -> None:
+        """Load-time torn-tail repair. Rounds (and blocks) above the
+        consensus anchor were written by an interrupted, pre-
+        transactional consensus pass — a complete pass commits its
+        writes and the advanced anchor atomically, so anything beyond
+        the anchor is by definition partial and is discarded; the
+        events feeding it survive (their sync batches committed) and
+        bootstrap's replay recomputes the decisions from scratch."""
+        with self._lock:
+            if legacy:
+                # Database written before the meta table existed: trust
+                # its rounds/blocks wholesale (they were written by a
+                # graceful-shutdown-only workflow) and seed the anchors
+                # from what is present.
+                row = self._db.execute(
+                    "SELECT COALESCE(MAX(idx), -1) FROM rounds").fetchone()
+                self._set_meta("consensus_anchor", str(row[0]))
+                row = self._db.execute(
+                    "SELECT COALESCE(MAX(rr), -1) FROM blocks").fetchone()
+                self._set_meta("last_committed_block", str(row[0]))
+                self._db.commit()
+                return
+            anchor = self._get_meta_int("consensus_anchor", -1)
+            cur = self._db.execute(
+                "DELETE FROM rounds WHERE idx > ?", (anchor,))
+            dropped = cur.rowcount
+            dropped += self._db.execute(
+                "DELETE FROM blocks WHERE rr > ?", (anchor,)).rowcount
+            if dropped:
+                self._db.commit()
+
+    def consensus_anchor(self) -> int:
+        return self._get_meta_int("consensus_anchor", -1)
+
+    def last_committed_block(self) -> int:
+        return self.inmem.last_committed_block()
+
+    def set_last_committed_block(self, rr: int) -> None:
+        """Durable delivered-block anchor: advanced by the node AFTER a
+        block reached the app, so bootstrap can suppress redelivery of
+        everything at or below it (exactly-once across restarts). If a
+        batch is open the write rides in it — deferred durability is
+        safe because the journal-keeping proxy dedupes redelivery of
+        the (small) unmarked window."""
+        if rr <= self.inmem.last_committed_block():
+            return
+        self.inmem.set_last_committed_block(rr)
+        with self._lock:
+            if self._closed:
+                return
+            self._set_meta("last_committed_block", str(rr))
+            self._commit()
+
+    # -- batch / transaction protocol --------------------------------------
+
+    def begin_batch(self) -> None:
+        """Open (or nest into) an atomic write batch. All writes until
+        the matching commit_batch land in one sqlite transaction."""
+        with self._lock:
+            self._batch_depth += 1
+
+    def commit_batch(self) -> None:
+        with self._lock:
+            if self._batch_depth == 0:
+                return
+            self._batch_depth -= 1
+            if self._batch_depth == 0:
+                self._commit(force=True)
+
+    def rollback_batch(self) -> None:
+        """Discard the open batch (all nesting levels): the in-flight
+        transaction is rolled back, so a failed sync batch or consensus
+        pass leaves no partial writes on disk. The inmem layer is NOT
+        rewound — callers abandon it wholesale (restart / engine
+        rebuild) after a rollback."""
+        with self._lock:
+            if self._batch_depth == 0:
+                return
+            self._batch_depth = 0
+            self._rounds_dirty = False
+            if not self._closed:
+                self._db.rollback()
+
+    def _commit(self, force: bool = False) -> None:
+        """Commit the connection's open transaction unless a batch is
+        in flight (then the outermost commit_batch commits). A pass
+        that wrote rounds advances the consensus anchor inside the same
+        transaction — the anchor and the rounds it covers are durable
+        or absent together."""
+        if self._batch_depth > 0 and not force:
+            return
+        if self._rounds_dirty:
+            self._db.execute(
+                "INSERT OR REPLACE INTO meta VALUES ('consensus_anchor', "
+                "(SELECT COALESCE(MAX(idx), -1) FROM rounds))")
+            self._rounds_dirty = False
+        t0 = time.perf_counter_ns()
+        self._db.commit()
+        dt = time.perf_counter_ns() - t0
+        self.fsync_count += 1
+        self.fsync_total_ns += dt
+        self.fsync_last_ns = dt
+
+    def wal_bytes(self) -> int:
+        try:
+            return os.path.getsize(self.path + "-wal")
+        except OSError:
+            return 0
+
+    def durability_stats(self) -> Dict[str, object]:
+        """Observability payload for /Stats, /debug/phases and bench:
+        the durable anchors, the sync policy, and the commit (WAL
+        write + fsync) count/latency."""
+        with self._lock:
+            return {
+                "store_sync": self.sync,
+                "last_committed_block": self.last_committed_block(),
+                "consensus_anchor": self.consensus_anchor(),
+                "fsync_count": self.fsync_count,
+                "fsync_total_ns": self.fsync_total_ns,
+                "fsync_last_ns": self.fsync_last_ns,
+                "wal_bytes": self.wal_bytes(),
+            }
 
     # -- participants / roots ---------------------------------------------
 
@@ -133,7 +374,7 @@ class FileStore:
                     for pk in participants
                 ],
             )
-            self._db.commit()
+            self._commit()
 
     def _db_participants(self) -> Dict[str, int]:
         with self._lock:
@@ -155,13 +396,14 @@ class FileStore:
             pass
         with self._lock:
             row = self._db.execute(
-                "SELECT data, topo FROM events WHERE hex = ?", (key,)
+                "SELECT data, topo, annotations FROM events WHERE hex = ?",
+                (key,)
             ).fetchone()
         if row is None:
             raise StoreError(StoreErrType.KEY_NOT_FOUND, key)
         ev = event_from_json_obj(json.loads(row[0]))
         ev.topological_index = row[1]
-        return ev
+        return _annotations_from_json(ev, row[2])
 
     def has_event(self, key: str) -> bool:
         if self.inmem.has_event(key):
@@ -179,22 +421,27 @@ class FileStore:
             # Replay order is the autoincrement seq (stable across
             # Reset, which restarts topological_index at 0); the topo
             # column preserves the engine-assigned index for reload.
-            # Coordinate back-propagation re-calls set_event on old
-            # events whose marshaled bytes never change, so conflicts
-            # only refresh topo.
+            # Coordinate back-propagation and round-received marking
+            # re-call set_event on old events whose marshaled bytes
+            # never change, so conflicts refresh only topo and the
+            # runtime annotations (wire/ancestry coordinates, consensus
+            # marks) — the db fallback must serve events as usable as
+            # the hot cache's.
             self._db.execute(
-                "INSERT INTO events (hex, creator, idx, topo, data) "
-                "VALUES (?, ?, ?, ?, ?) "
-                "ON CONFLICT(hex) DO UPDATE SET topo = excluded.topo",
+                "INSERT INTO events (hex, creator, idx, topo, data, "
+                "annotations) VALUES (?, ?, ?, ?, ?, ?) "
+                "ON CONFLICT(hex) DO UPDATE SET topo = excluded.topo, "
+                "annotations = excluded.annotations",
                 (
                     event.hex(),
                     event.creator(),
                     event.index(),
                     event.topological_index,
                     json.dumps(obj),
+                    _annotations_to_json(event),
                 ),
             )
-            self._db.commit()
+            self._commit()
 
     def participant_events(self, participant: str, skip: int) -> List[str]:
         try:
@@ -238,15 +485,15 @@ class FileStore:
             pass
         with self._lock:
             rows = self._db.execute(
-                "SELECT data, topo FROM events WHERE creator = ? AND idx > ? "
-                "ORDER BY idx",
+                "SELECT data, topo, annotations FROM events "
+                "WHERE creator = ? AND idx > ? ORDER BY idx",
                 (participant, skip),
             ).fetchall()
         out = []
-        for data, topo in rows:
+        for data, topo, ann in rows:
             ev = event_from_json_obj(json.loads(data))
             ev.topological_index = topo
-            out.append(ev)
+            out.append(_annotations_from_json(ev, ann))
         return out
 
     def participant_event(self, participant: str, index: int) -> str:
@@ -297,7 +544,8 @@ class FileStore:
                 "INSERT OR REPLACE INTO rounds VALUES (?, ?)",
                 (r, _round_to_json(round_info)),
             )
-            self._db.commit()
+            self._rounds_dirty = True
+            self._commit()
 
     def last_round(self) -> int:
         lr = self.inmem.last_round()
@@ -353,21 +601,60 @@ class FileStore:
                 "INSERT OR REPLACE INTO blocks VALUES (?, ?)",
                 (block.round_received, data),
             )
-            self._db.commit()
+            self._commit()
 
     def reset(self, roots: Dict[str, Root]) -> None:
+        """Frame reset: the database drops pre-reset history along with
+        the hot cache. Keeping the old event log would poison the next
+        restart — bootstrap replays the log against the NEW roots, and
+        pre-reset events fail their parent checks there (and the db
+        fallback reads would serve stale pre-reset history meanwhile).
+        A reset store serves only post-reset state, exactly like
+        InmemStore. One transaction: a kill mid-reset leaves the old
+        store intact."""
         self.inmem.reset(roots)
         with self._lock:
-            self._db.executemany(
-                "INSERT OR REPLACE INTO roots VALUES (?, ?)",
-                [(pk, json.dumps(r.to_dict())) for pk, r in roots.items()],
-            )
-            self._db.commit()
+            self.begin_batch()
+            try:
+                self._db.execute("DELETE FROM events")
+                self._db.execute("DELETE FROM rounds")
+                self._db.execute("DELETE FROM blocks")
+                self._set_meta("consensus_anchor", "-1")
+                self._db.executemany(
+                    "INSERT OR REPLACE INTO roots VALUES (?, ?)",
+                    [(pk, json.dumps(r.to_dict())) for pk, r in roots.items()],
+                )
+                self.commit_batch()
+            except BaseException:
+                self.rollback_batch()
+                raise
 
     def close(self) -> None:
+        """Idempotent, exception-safe close: an interrupted batch is
+        rolled back (half a protocol batch on disk would violate the
+        atomicity contract), otherwise any open transaction is
+        committed; double close is a no-op and nothing here raises."""
         with self._lock:
-            self._db.commit()
-            self._db.close()
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                if self._batch_depth > 0:
+                    self._batch_depth = 0
+                    self._rounds_dirty = False
+                    self._db.rollback()
+                else:
+                    self._commit()
+            except Exception:  # noqa: BLE001 - close must never raise
+                try:
+                    self._db.rollback()
+                except Exception:  # noqa: BLE001
+                    pass
+            finally:
+                try:
+                    self._db.close()
+                except Exception:  # noqa: BLE001
+                    pass
 
     # -- bootstrap feed ----------------------------------------------------
 
@@ -377,9 +664,11 @@ class FileStore:
         Hashgraph.bootstrap()."""
         with self._lock:
             rows = self._db.execute(
-                "SELECT data, topo FROM events ORDER BY seq"
+                "SELECT data, topo, annotations FROM events ORDER BY seq"
             ).fetchall()
-        for data, topo in rows:
+        for data, topo, ann in rows:
             ev = event_from_json_obj(json.loads(data))
             ev.topological_index = topo
-            yield ev
+            # Wire info rides along so the replay can re-serve diffs;
+            # ancestry coordinates are rebuilt by insert_event anyway.
+            yield _annotations_from_json(ev, ann)

@@ -308,7 +308,11 @@ class Hashgraph:
         at insert time (hashgraph.go:404-420)."""
         creator_last_known, _ = self.store.last_from(event.creator())
         if event.self_parent() != creator_last_known:
-            raise InsertError("Self-parent not last known event by creator")
+            raise InsertError(
+                "Self-parent not last known event by creator "
+                f"(creator={event.creator()[:12]} idx={event.index()} "
+                f"self_parent={event.self_parent()[:12]} "
+                f"last_known={creator_last_known[:12]})")
 
     def _check_other_parent(self, event: Event) -> None:
         other_parent = event.other_parent()
@@ -378,6 +382,11 @@ class Hashgraph:
                 try:
                     a = self.store.get_event(ah)
                 except StoreError:
+                    break
+                if not a.first_descendants:
+                    # Legacy persistent row without annotation sidecar
+                    # (pre-v2 FileStore): its coordinates are gone;
+                    # treat like a missing ancestor and stop the walk.
                     break
                 if a.first_descendants[creator_id].index == MAX_INT32:
                     a.first_descendants[creator_id] = EventCoordinates(
@@ -708,9 +717,22 @@ class Hashgraph:
         # `unlocked` is the device engine's lock-release seam
         # (tpu_graph.py); the host pipeline has no blocking device wait
         # to release around.
-        self.divide_rounds()
-        self.decide_fame()
-        self.find_order()
+        #
+        # The pass's store writes (round rows, fame updates, received
+        # events, blocks) form one atomic batch: a process killed
+        # mid-pass leaves no partial consensus pass on disk (the
+        # durable store's consensus anchor advances in the same
+        # transaction). On a mid-pass software error the finally
+        # commits the prefix — identical durability to the historical
+        # per-statement commits, and required because the write-through
+        # hot cache has already seen those writes.
+        self.store.begin_batch()
+        try:
+            self.divide_rounds()
+            self.decide_fame()
+            self.find_order()
+        finally:
+            self.store.commit_batch()
 
     # -- queries -----------------------------------------------------------
 
@@ -738,20 +760,51 @@ class Hashgraph:
         last_consensus_round = self.store.get_round(last_consensus_round_index)
         witness_hashes = last_consensus_round.witnesses()
 
+        # Per-creator floor of UNDETERMINED events: an event not yet in
+        # any block whose index sits below the witness cut would be
+        # silently dropped from the frame — the fast-syncing peer could
+        # then never recover its transactions, and its re-decided
+        # boundary blocks would miss them (observed by the kill -9
+        # harness as a block diverging from the survivors'). Pull each
+        # creator's cut back to cover them.
+        oldest_undetermined: Dict[str, int] = {}
+        for x in self.undetermined_events:
+            try:
+                ex = self.store.get_event(x)
+            except StoreError:
+                continue
+            c = ex.creator()
+            if ex.index() < oldest_undetermined.get(c, MAX_INT32):
+                oldest_undetermined[c] = ex.index()
+
+        def cut_to(first: Event):
+            """Root before `first` + every event of its creator from
+            `first` on, honoring the undetermined floor."""
+            c = first.creator()
+            floor = min(first.index(), oldest_undetermined.get(c, MAX_INT32))
+            if floor < first.index():
+                first = self.store.get_event(
+                    self.store.participant_event(c, floor))
+            root = Root(
+                x=first.self_parent(),
+                y=first.other_parent(),
+                index=first.index() - 1,
+                round=self.round(first.self_parent()),
+                others={},
+            )
+            evs = [first] + [
+                self.store.get_event(e)
+                for e in self.store.participant_events(c, first.index())
+            ]
+            return root, evs
+
         events: List[Event] = []
         roots: Dict[str, Root] = {}
         for wh in witness_hashes:
             w = self.store.get_event(wh)
-            events.append(w)
-            roots[w.creator()] = Root(
-                x=w.self_parent(),
-                y=w.other_parent(),
-                index=w.index() - 1,
-                round=self.round(w.self_parent()),
-                others={},
-            )
-            for e in self.store.participant_events(w.creator(), w.index()):
-                events.append(self.store.get_event(e))
+            root, evs = cut_to(w)
+            roots[w.creator()] = root
+            events.extend(evs)
 
         # Participants without a witness in the last consensus round use
         # their last known event (hashgraph.go:942-973).
@@ -762,14 +815,8 @@ class Hashgraph:
                     root = self.store.get_root(p)
                 else:
                     ev = self.store.get_event(last)
-                    events.append(ev)
-                    root = Root(
-                        x=ev.self_parent(),
-                        y=ev.other_parent(),
-                        index=ev.index() - 1,
-                        round=self.round(ev.self_parent()),
-                        others={},
-                    )
+                    root, evs = cut_to(ev)
+                    events.extend(evs)
                 roots[p] = root
 
         events.sort(key=lambda e: e.topological_index)
@@ -790,21 +837,54 @@ class Hashgraph:
         """Replay a persistent store's topological event log and recompute
         consensus to the tip (hashgraph.go:1008-1037).
 
-        Commit callbacks are suppressed during replay: recovery rebuilds
-        state that was already delivered to the application before the
-        restart, so re-emitting every historical block would double-apply
-        app state (and, with a bounded commit queue and no consumer
-        running yet, deadlock startup)."""
+        Exactly-once redelivery across restarts: commits for rounds at
+        or below the store's durable delivered-block anchor
+        (`last_committed_block`, advanced by the node after each block
+        reaches the application) are suppressed — that history was
+        already applied, and re-emitting it would double-apply app
+        state (and, with a bounded commit queue and no consumer running
+        yet, risk deadlocking startup). Anything the replay decides
+        ABOVE the anchor was committed by consensus but never durably
+        delivered — the torn tail of a crash between consensus and app
+        delivery — and is re-emitted so the interrupted delivery
+        completes.
+
+        The whole replay (event re-inserts + the recompute's round and
+        block writes) runs as one store batch: a restart killed during
+        bootstrap leaves the database exactly as the previous crash
+        left it."""
         db_events = getattr(self.store, "db_topological_events", None)
         if db_events is None:
             return
         saved_cb = self.commit_callback
-        self.commit_callback = None
+        anchor = self.store.last_committed_block()
+
+        def gated(block: Block) -> None:
+            if block.round_received <= anchor:
+                return
+            saved_cb(block)
+
+        self.commit_callback = gated if saved_cb is not None else None
+        self.store.begin_batch()
         try:
             for e in db_events():
-                self.insert_event(e, True)
+                # Strip persisted consensus marks (cf. failover replay):
+                # the recompute below re-derives them; letting stale
+                # ones leak into find_order before the replay decides
+                # the round would bypass the recompute.
+                e.round_received = None
+                e.consensus_timestamp = ZERO_TIME
+                try:
+                    self.insert_event(e, True)
+                except StoreError:
+                    # Same fallback as fast_forward replay: an event
+                    # whose other-parent predates the store's roots
+                    # (a post-fast-forward log, Root.others) cannot
+                    # carry wire info.
+                    self.insert_event(e, False)
             self.divide_rounds()
             self.decide_fame()
             self.find_order()
         finally:
+            self.store.commit_batch()
             self.commit_callback = saved_cb

@@ -39,6 +39,7 @@ class InmemStore:
         }
         self.roots: Dict[str, Root] = {pk: new_base_root() for pk in participants}
         self._last_round = -1
+        self._last_committed_block = -1
 
     def cache_size(self) -> int:
         return self._cache_size
@@ -61,14 +62,36 @@ class InmemStore:
 
     def set_event(self, event: Event) -> None:
         key = event.hex()
-        known = self.event_cache.contains(key)
-        if not known:
+        win = self._event_obj_windows.get(event.creator())
+        if win is None:
+            win = RollingIndex(self._cache_size)
+            self._event_obj_windows[event.creator()] = win
+        if event.index() > win.last_index:
+            # Genuinely new for this creator: advances both windows
+            # (still raises SkippedIndex on a gap, like the reference).
             self.participant_events_cache.add(event.creator(), key, event.index())
-            win = self._event_obj_windows.get(event.creator())
-            if win is None:
-                win = RollingIndex(self._cache_size)
-                self._event_obj_windows[event.creator()] = win
             win.add(event, event.index())
+        else:
+            # Re-store of an index the windows already passed:
+            # coordinate back-propagation and round-received marking
+            # re-call set_event on old events, and once the LRU event
+            # cache has evicted one, keying this branch off LRU
+            # membership (the previous behavior) mis-reads the re-store
+            # as new and dies on PassedIndex — which aborts an insert
+            # HALFWAY (event in the window, caller's head/seq never
+            # updated) and wedges the node. The windows are the source
+            # of truth for per-creator indexes: an identical hash at
+            # the index is an idempotent refresh, a different one is a
+            # genuine fork and still raises.
+            try:
+                existing = self.participant_events_cache.get_item(
+                    event.creator(), event.index())
+            except StoreError as err:
+                if not is_store_err(err, StoreErrType.TOO_LATE):
+                    raise
+                existing = key  # aged out of the window: trust the caller
+            if existing != key:
+                raise StoreError(StoreErrType.PASSED_INDEX, key)
         self.event_cache.add(key, event)
 
     def participant_events(self, participant: str, skip: int) -> List[str]:
@@ -165,6 +188,25 @@ class InmemStore:
             pk: RollingIndex(self._cache_size) for pk in self._participants
         }
         self._last_round = -1
+
+    # Atomicity seam (store.py): nothing here outlives the process, so
+    # batches are free — there is no durable state to tear.
+
+    def begin_batch(self) -> None:
+        pass
+
+    def commit_batch(self) -> None:
+        pass
+
+    def rollback_batch(self) -> None:
+        pass
+
+    def last_committed_block(self) -> int:
+        return self._last_committed_block
+
+    def set_last_committed_block(self, rr: int) -> None:
+        if rr > self._last_committed_block:
+            self._last_committed_block = rr
 
     def close(self) -> None:
         pass

@@ -7,6 +7,15 @@ mirrored store used by the batched engine.
 
 Error convention: methods raise StoreError (common/errors.py) rather
 than returning Go-style (value, error) pairs.
+
+Atomicity seam (docs/robustness.md "Crash recovery"): writers group
+related mutations — one sync batch's event inserts, one consensus
+pass's round/block writes — between `begin_batch()` and
+`commit_batch()`. A durable store makes the group one transaction
+(all-or-nothing under kill -9); volatile stores treat the calls as
+no-ops. `last_committed_block` is the durable delivered-block anchor:
+the node advances it after a block reaches the application, and
+`Hashgraph.bootstrap` suppresses redelivery at or below it.
 """
 
 from __future__ import annotations
@@ -74,5 +83,26 @@ class Store(Protocol):
     def set_block(self, block: Block) -> None: ...
 
     def reset(self, roots: Dict[str, Root]) -> None: ...
+
+    def begin_batch(self) -> None:
+        """Open (or nest into) an atomic write batch; writes until the
+        matching commit_batch become durable together. No-op for
+        volatile stores."""
+        ...
+
+    def commit_batch(self) -> None: ...
+
+    def rollback_batch(self) -> None:
+        """Discard the open batch's durable writes (crash-equivalent).
+        The volatile hot layer is NOT rewound — callers abandon it
+        (restart, engine rebuild) after a rollback."""
+        ...
+
+    def last_committed_block(self) -> int:
+        """Round of the last block known delivered to the application
+        (-1 when none) — the exactly-once redelivery anchor."""
+        ...
+
+    def set_last_committed_block(self, rr: int) -> None: ...
 
     def close(self) -> None: ...

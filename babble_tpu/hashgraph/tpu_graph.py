@@ -112,7 +112,7 @@ class TpuHashgraph(Hashgraph):
 
     def run_consensus(self, unlocked=None) -> None:
         delta = self.engine.run(unlocked=unlocked)
-        self._apply_delta(delta)
+        self._apply_delta_atomically(delta)
 
     # Async pipeline seam (node/_consensus_loop with pipeline_depth >
     # 0): dispatch enqueues the whole device pass and returns
@@ -125,10 +125,22 @@ class TpuHashgraph(Hashgraph):
 
     def collect_consensus(self, pending, unlocked=None) -> None:
         delta = self.engine.collect(pending, unlocked=unlocked)
-        self._apply_delta(delta)
+        self._apply_delta_atomically(delta)
 
     def abandon_consensus(self, pending) -> None:
         self.engine.abandon(pending)
+
+    def _apply_delta_atomically(self, delta: RunDelta) -> None:
+        """Mirror one device pass into the Store as one atomic batch.
+        The batch opens AFTER the device wait (engine.run/collect do no
+        store writes), so it never spans the unlocked seam — gossip
+        inserts landing during the device round trip commit in their
+        own sync batches, not inside the consensus transaction."""
+        self.store.begin_batch()
+        try:
+            self._apply_delta(delta)
+        finally:
+            self.store.commit_batch()
 
     def divide_rounds(self) -> None:  # test-surface compatibility
         self.run_consensus()

@@ -20,6 +20,13 @@ class Config:
     sync_limit: int = 100
     store_type: str = "inmem"  # "inmem" | "file"
     store_path: str = ""
+    # Durable-store fsync policy (FileStore, docs/robustness.md "Crash
+    # recovery"): "always" fsyncs the WAL on every commit (survives
+    # power loss), "batch" (default) fsyncs at WAL checkpoints —
+    # commits stay atomic under kill -9 either way — and "off" skips
+    # fsyncs entirely (fastest; atomic under process death, not power
+    # loss).
+    store_sync: str = "batch"  # "always" | "batch" | "off"
     # Consensus engine: "host" (incremental reference-semantics Python)
     # or "tpu" (batched device pipeline behind the same seam).
     engine: str = "host"

@@ -165,7 +165,20 @@ class Core:
         top of GetFrame/Reset (hashgraph.go:879-1002); signatures are
         re-verified by insert_event, so a malicious frame cannot forge
         events. Both engines support Reset (the device engine rebuilds
-        with offset chain bases, tpu_graph.reset)."""
+        with offset chain bases, tpu_graph.reset).
+
+        One store batch spans reset + frame replay: a process killed
+        mid-fast-forward leaves the previous durable state intact (the
+        restart simply fast-forwards again) instead of a roots-only
+        store holding half a frame."""
+        store = self.hg.store
+        store.begin_batch()
+        try:
+            self._fast_forward_replay(roots, events)
+        finally:
+            store.commit_batch()
+
+    def _fast_forward_replay(self, roots, events: List[Event]) -> None:
         self.hg.reset(roots)
         try:
             for ev in events:
@@ -291,29 +304,42 @@ class Core:
                 verify_events(to_verify, self.verify_workers)
         self._timed("verify", t0)
 
+        # One sync batch = one durable transaction (store.py atomicity
+        # seam): the inserted events AND the self-event wrapping them
+        # become visible-after-crash together or not at all. On a
+        # mid-loop software error the finally commits the inserted
+        # prefix — the write-through hot cache already holds those
+        # events, and rolling the database back under it would let
+        # later has_event hits mask never-persisted events.
         t0 = time.perf_counter_ns()
         other_head = ""
-        for k, ev in enumerate(events):
-            if not has_event(ev.hex()):
-                self.insert_event(ev, False)
-            if k == len(events) - 1:
-                # Head selection: the peer's head is the LAST event of
-                # its diff even when that event was skipped as a
-                # duplicate (its stored copy may differ in wire
-                # indexes, but the hash covers only {Body, R, S}, so
-                # the hex names the stored copy identically).
-                other_head = ev.hex()
-        self._timed("insert", t0)
+        store = self.hg.store
+        store.begin_batch()
+        try:
+            for k, ev in enumerate(events):
+                if not has_event(ev.hex()):
+                    self.insert_event(ev, False)
+                if k == len(events) - 1:
+                    # Head selection: the peer's head is the LAST event
+                    # of its diff even when that event was skipped as a
+                    # duplicate (its stored copy may differ in wire
+                    # indexes, but the hash covers only {Body, R, S},
+                    # so the hex names the stored copy identically).
+                    other_head = ev.hex()
+            self._timed("insert", t0)
 
-        if len(unknown) > 0 or len(self.transaction_pool) > 0:
-            new_head = Event.new(
-                list(self.transaction_pool),
-                [self.head, other_head],
-                self.pub_key(),
-                self.seq + 1,
-            )
-            self.sign_and_insert_self_event(new_head)
-            self.transaction_pool = []
+            if len(unknown) > 0 or len(self.transaction_pool) > 0:
+                new_head = Event.new(
+                    list(self.transaction_pool),
+                    [self.head, other_head],
+                    self.pub_key(),
+                    self.seq + 1,
+                )
+                self.sign_and_insert_self_event(new_head)
+                self.transaction_pool = []
+        finally:
+            store.commit_batch()
+        self._merge_store_phases()
         self._timed("sync", t_sync)
 
     def add_self_event(self) -> None:
@@ -341,6 +367,7 @@ class Core:
         self.hg.run_consensus(unlocked=unlocked)
         self._timed("run_consensus", t0)
         self._merge_engine_phases()
+        self._merge_store_phases()
 
     # -- async consensus pipeline (device engine only) ----------------------
 
@@ -368,6 +395,7 @@ class Core:
         self.hg.collect_consensus(pending, unlocked=unlocked)
         self._timed("consensus_collect", t0)
         self._merge_engine_phases()
+        self._merge_store_phases()
 
     def abandon_consensus(self, pending) -> None:
         if pending is not None and hasattr(self.hg, "abandon_consensus"):
@@ -476,6 +504,17 @@ class Core:
             ent[0] = overlap
             ent[1] += overlap
             ent[2] += 1
+
+    def _merge_store_phases(self) -> None:
+        # Durable-commit wall (FileStore WAL write + fsync) as a phase:
+        # the store's lifetime counters map 1:1 onto a phase_ns triple,
+        # so /debug/phases and bench's store_commit_share get the
+        # durable-path overhead without a timer on every store call.
+        count = getattr(self.hg.store, "fsync_count", 0)
+        if count:
+            store = self.hg.store
+            self.phase_ns["store_commit"] = [
+                store.fsync_last_ns, store.fsync_total_ns, count]
 
     def add_transactions(self, txs: List[bytes]) -> None:
         self.transaction_pool.extend(txs)

@@ -24,8 +24,10 @@ reference, whose gossip loop retries dead peers forever."""
 from __future__ import annotations
 
 import contextlib
+import os
 import queue
 import random
+import signal
 import threading
 import time
 from typing import Dict, List, Optional
@@ -114,6 +116,21 @@ class Node:
         self.fast_forwards = 0
         self._stats_lock = threading.Lock()  # counters hit by gossip + RPC threads
 
+        # Seeded crash points for the kill -9 harness
+        # (tests/crash_harness.py): a positive count SIGKILLs this
+        # process — no cleanup, no atexit, the real thing — right
+        # after the Nth block delivery (mid-commit: after the app saw
+        # the block, BEFORE the durable delivered marker advances) or
+        # the Nth applied sync (mid-gossip: events durable, consensus
+        # pass for them not yet run). Production runs never set these.
+        self._crash_after_commits = int(
+            os.environ.get("BABBLE_CRASH_AFTER_COMMITS", "0"))
+        self._crash_after_syncs = int(
+            os.environ.get("BABBLE_CRASH_AFTER_SYNCS", "0"))
+        self._commits_delivered = 0
+        self._syncs_applied = 0
+        self._shutdown_done = False
+
     # -- lifecycle ---------------------------------------------------------
 
     def init(self, bootstrap: bool = False) -> None:
@@ -145,14 +162,40 @@ class Node:
                 return
 
     def shutdown(self) -> None:
-        if self.state.get_state() == NodeState.SHUTDOWN:
-            return
+        # Guarded by its own flag, NOT the state machine: a signal
+        # handler (cli.py) requests shutdown by setting the SHUTDOWN
+        # state so run() returns, and the real teardown below must
+        # still happen exactly once afterwards.
+        with self._stats_lock:
+            if self._shutdown_done:
+                return
+            self._shutdown_done = True
         self.state.set_state(NodeState.SHUTDOWN)
         self._shutdown.set()
         self._work.put(("shutdown", None))
         self.control_timer.shutdown()
         self.state.wait_routines(timeout=2.0)
         self.trans.close()
+        # Graceful drain: blocks the consensus worker decided but the
+        # (now stopped) background worker never delivered would
+        # otherwise be dropped on the floor — deliver them so the app
+        # and the durable delivered marker agree with the store before
+        # it closes (the commit_ch forwarder may have moved some onto
+        # _work; drain both).
+        for q in (self.commit_ch, self._work):
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+                if q is self._work:
+                    tag, item = item
+                    if tag != "block":
+                        continue
+                try:
+                    self._commit(item)
+                except Exception as exc:  # noqa: BLE001
+                    self.logger.error("shutdown commit failed: %s", exc)
         self.core.hg.store.close()
 
     # -- background work ---------------------------------------------------
@@ -519,6 +562,14 @@ class Node:
         this node keeps answering pulls and accepting pushes while the
         verify pool grinds the batch."""
         self.core.sync(events, unlocked=self._core_unlocked)
+        self._syncs_applied += 1
+        if self._crash_after_syncs and \
+                self._syncs_applied >= self._crash_after_syncs:
+            # Mid-gossip crash point: the sync batch just committed
+            # durably; the consensus pass that would decide it has not
+            # run. Restart must replay these events and reach the same
+            # order the survivors commit.
+            os.kill(os.getpid(), signal.SIGKILL)
         if self.conf.consensus_interval <= 0:
             self.core.run_consensus()
 
@@ -646,6 +697,17 @@ class Node:
 
     def _commit(self, block: Block) -> None:
         self.proxy.commit_block(block)
+        self._commits_delivered += 1
+        if self._crash_after_commits and \
+                self._commits_delivered >= self._crash_after_commits:
+            # Mid-commit crash point: the app has the block, the
+            # durable marker below has NOT advanced — restart re-emits
+            # this block and the journal-keeping proxy must dedupe it.
+            os.kill(os.getpid(), signal.SIGKILL)
+        # Durable delivered anchor AFTER the app delivery: a crash
+        # between the two re-delivers (suppressed by the proxy's own
+        # journal tail), never loses, the block.
+        self.core.hg.store.set_last_committed_block(block.round_received)
 
     def _add_transaction(self, tx: bytes) -> None:
         with self.core_lock:
@@ -677,6 +739,27 @@ class Node:
             if last_consensus_round is not None and elapsed > 0
             else 0.0
         )
+        # Durability view (docs/robustness.md "Crash recovery"): the
+        # volatile store reports its in-memory anchor; FileStore adds
+        # sync policy and commit/fsync counters.
+        store = self.core.hg.store
+        dstats = getattr(store, "durability_stats", None)
+        if dstats is not None:
+            d = dstats()
+            durability = {
+                "store_type": "file",
+                "store_sync": str(d["store_sync"]),
+                "last_committed_block": str(d["last_committed_block"]),
+                "fsync_count": str(d["fsync_count"]),
+                "fsync_avg_us": str(
+                    d["fsync_total_ns"] // max(d["fsync_count"], 1) // 1000),
+                "wal_bytes": str(d["wal_bytes"]),
+            }
+        else:
+            durability = {
+                "store_type": "inmem",
+                "last_committed_block": str(store.last_committed_block()),
+            }
         return {
             "last_consensus_round": (
                 "nil" if last_consensus_round is None else str(last_consensus_round)
@@ -700,7 +783,7 @@ class Node:
             "pipeline_depth": str(getattr(self.conf, "pipeline_depth", 0)),
             "id": str(self.id),
             "state": str(self.state.get_state()),
-        } | {
+        } | durability | {
             # Per-phase wall times (reference logs ns around every
             # Diff/Sync/RunConsensus call, node/core.go:277-296): last
             # call and lifetime average per phase. list() snapshots the

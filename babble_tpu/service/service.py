@@ -65,6 +65,14 @@ class Service:
                         for ph, ent in list(core.phase_ns.items())
                     }
                     out = {"phases": phases}
+                    dstats = getattr(core.hg.store, "durability_stats",
+                                     None)
+                    if dstats is not None:
+                        # Durable-path attribution (docs/robustness.md
+                        # "Crash recovery"): commit/fsync counters, the
+                        # delivered-block and consensus anchors, and
+                        # the live WAL size.
+                        out["store"] = dstats()
                     engine = getattr(core.hg, "engine", None)
                     if engine is not None:
                         # Host-blocking vs overlapped device time of the
@@ -131,6 +139,28 @@ class Service:
                         self._json(500, {"error": str(exc)})
                     finally:
                         service._profile_lock.release()
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def do_POST(self):  # noqa: N802 - stdlib API
+                url = urlparse(self.path)
+                if url.path.rstrip("/") == "/submit":
+                    # Transaction intake without a socket app client:
+                    # the body is one raw transaction. Used by the
+                    # crash harness (whose nodes run --journal) and
+                    # handy for curl-driven demos; like /debug/*, bind
+                    # service_addr to localhost in production.
+                    try:
+                        length = int(self.headers.get("Content-Length", 0))
+                        tx = self.rfile.read(length)
+                        if not tx:
+                            self._json(400, {"error": "empty transaction"})
+                            return
+                        service.node.submit_tx(tx)
+                        self._json(200, {"submitted": len(tx)})
+                    except Exception as exc:  # noqa: BLE001
+                        self._json(500, {"error": str(exc)})
                 else:
                     self.send_response(404)
                     self.end_headers()

@@ -307,7 +307,8 @@ def host_engine_events_per_sec(n_peers, n_events, seed=7):
 
 def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
                                 window_s=30.0, interval=None,
-                                warm_gate_events=1500, windows=1):
+                                warm_gate_events=1500, windows=1,
+                                store="inmem", store_sync="batch"):
     """Throughput of a live localhost testnet: N real nodes (threads,
     inmem transport, signed events, full sync protocol) bombarded with
     transactions; returns (committed consensus events/sec during a
@@ -333,8 +334,10 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
         _jax.config.update(
             "jax_persistent_cache_min_compile_time_secs", 0.0)
 
+    import tempfile
+
     from babble_tpu import crypto
-    from babble_tpu.hashgraph import InmemStore
+    from babble_tpu.hashgraph import FileStore, InmemStore
     from babble_tpu.net import InmemTransport, Peer
     from babble_tpu.net.inmem_transport import connect_all
     from babble_tpu.node import Node
@@ -373,7 +376,18 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
             # _consensus_loop).
             interval = 0.25 if engine == "tpu" else 0.0
         conf.consensus_interval = interval
-        node = Node(conf, i, key, peers, InmemStore(participants, 100000),
+        if store == "file":
+            # Durable-path A/B (docs/robustness.md "Crash recovery"):
+            # same testnet over WAL-backed FileStores, so the
+            # store_commit_share below measures the transactional
+            # overhead against the in-mem baseline.
+            sdir = tempfile.mkdtemp(prefix="bench-store-")
+            node_store = FileStore(
+                participants, 100000,
+                os.path.join(sdir, f"node{i}.db"), sync=store_sync)
+        else:
+            node_store = InmemStore(participants, 100000)
+        node = Node(conf, i, key, peers, node_store,
                     transports[i], InmemAppProxy())
         node.init()
         nodes.append(node)
@@ -444,7 +458,8 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
         ingest = {ph: v for ph, v in tot.items()
                   if ph in ("from_wire", "verify", "insert")}
         top = {ph: v for ph, v in tot.items()
-               if not ph.startswith("engine_") and ph not in ingest}
+               if not ph.startswith("engine_") and ph not in ingest
+               and ph != "store_commit"}
         if top:
             s = sum(top.values())
             phases["phase_share"] = {
@@ -465,6 +480,13 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
         if "engine_overlap" in tot:
             phases["engine_overlap_s"] = round(
                 tot["engine_overlap"] / 1e9, 2)
+        if "store_commit" in tot and top:
+            # Durable-commit wall (sqlite COMMIT = WAL write + fsync,
+            # a sub-span of sync/run_consensus) as a share of the
+            # top-level phase wall: what the durable path costs vs
+            # in-mem.
+            phases["store_commit_share"] = round(
+                tot["store_commit"] / sum(top.values()), 3)
     finally:
         _sys.setswitchinterval(old_switch)
         stop.set()
@@ -508,6 +530,19 @@ def node_smoke():
         payload["error"] = str(exc)
         _emit(payload)
         return 1
+    try:
+        # Durable-path leg: the same smoke over WAL-backed FileStores.
+        # store_commit_share is the fraction of node phase wall spent
+        # in sqlite COMMITs; the events/s delta against the in-mem leg
+        # above is the full durable-path overhead (record in BENCH).
+        feps, fphases = node_testnet_events_per_sec(
+            engine="host", n_nodes=3, warm_s=8.0, window_s=12.0,
+            interval=0.0, warm_gate_events=200, windows=1,
+            store="file")
+        payload["node_file_events_per_s"] = round(feps, 1)
+        payload["store_commit_share"] = fphases.get("store_commit_share")
+    except Exception as exc:  # noqa: BLE001
+        payload["file_store_error"] = str(exc)
     _emit(payload)
     return 0
 
@@ -761,6 +796,25 @@ def child():
                 _emit(payload)
             except Exception as exc:  # noqa: BLE001
                 log(f"  node host stage failed: {exc}")
+        if _budget_left() > 150:
+            try:
+                # Durable-path A/B: the same host testnet on WAL-backed
+                # FileStores. store_commit_share = fraction of node
+                # phase wall inside sqlite COMMITs; the events/s delta
+                # vs node_events_per_s is the full durable overhead.
+                file_eps, file_ph = node_testnet_events_per_sec(
+                    engine="host", warm_s=30.0, window_s=30.0,
+                    store="file")
+                log(f"  4-node --engine host --store file testnet: "
+                    f"{file_eps:,.1f} committed events/s "
+                    f"(store_commit_share "
+                    f"{file_ph.get('store_commit_share')})")
+                payload["node_file_events_per_s"] = round(file_eps, 1)
+                payload["store_commit_share"] = file_ph.get(
+                    "store_commit_share")
+                _emit(payload)
+            except Exception as exc:  # noqa: BLE001
+                log(f"  node file-store stage failed: {exc}")
         if _budget_left() > 520 and not on_cpu:
             try:
                 # The warm gate shrank 6000 -> 2500 committed events:

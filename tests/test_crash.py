@@ -1,0 +1,124 @@
+"""Kill -9 crash-durability suite (ISSUE 4 acceptance).
+
+Every test here runs REAL `babble_tpu.cli run` subprocesses over TCP
+with FileStores and journal app proxies (tests/crash_harness.py), so a
+SIGKILL is a genuine process death: no atexit, no flush, the sqlite
+transaction torn at whatever instruction the kernel caught it.
+
+Targeted tests pin the two hardest crash points exactly via the node's
+seeded self-kill hooks (BABBLE_CRASH_AFTER_COMMITS / _AFTER_SYNCS):
+mid-commit (app delivered, durable marker not yet advanced — restart
+must NOT double-deliver) and mid-gossip (sync batch durable, consensus
+for it not yet run — restart must replay to the survivors' exact
+order). The soak drives seeded random SIGKILLs on top.
+
+All slow-marked (subprocess testnets); CI's crash-smoke job runs them."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from crash_harness import CrashTestnet, run_soak
+
+pytestmark = pytest.mark.slow
+
+
+def _cycle_victim(net, victim, env_extra, target_extra=2, timeout=240.0):
+    """Start all nodes (victim with the self-kill env), wait for the
+    victim to die at its crash point, advance the survivors, restart
+    the victim with --bootstrap, and reconverge everyone."""
+    for node in net.nodes:
+        if node is victim:
+            node.start(env_extra=env_extra)
+        else:
+            node.start()
+    net.wait_up([n for n in net.nodes if n is not victim])
+
+    # Feed traffic until the victim's crash point fires.
+    deadline = time.monotonic() + timeout
+    while victim.alive():
+        assert time.monotonic() < deadline, "crash point never fired"
+        try:
+            victim.submit(f"trigger tx {net._tx_seq}".encode())
+            net._tx_seq += 1
+        except Exception:  # noqa: BLE001
+            pass
+        time.sleep(0.02)
+    victim.wait_dead()
+
+    survivors = [n for n in net.nodes if n is not victim]
+    net.bombard_until(target_round=net.max_round() + target_extra,
+                      timeout=timeout, require=survivors)
+
+    victim.start()  # --bootstrap implied: store.db exists
+    net.wait_up([victim])
+    net.bombard_until(target_round=net.max_round() + 1, timeout=timeout)
+
+
+def test_kill9_mid_commit(tmp_path):
+    """SIGKILL between app delivery and the durable delivered marker:
+    the restart re-emits the unmarked block and the journal dedupe must
+    swallow it — zero duplicate deliveries, byte-identical order."""
+    net = CrashTestnet(4, str(tmp_path), seed=404)
+    victim = net.nodes[1]
+    try:
+        _cycle_victim(net, victim,
+                      {"BABBLE_CRASH_AFTER_COMMITS": "2"})
+    finally:
+        net.shutdown_all()
+    result = net.assert_invariants()
+    assert result["deliveries"] > 0
+    assert victim.kills == 0  # it killed ITSELF at the crash point
+
+
+def test_kill9_mid_gossip(tmp_path):
+    """SIGKILL right after a sync batch committed durably, before any
+    consensus pass decided it: bootstrap must replay the torn tail and
+    reach the survivors' exact block order."""
+    net = CrashTestnet(4, str(tmp_path), seed=405)
+    victim = net.nodes[2]
+    try:
+        _cycle_victim(net, victim,
+                      {"BABBLE_CRASH_AFTER_SYNCS": "4"})
+    finally:
+        net.shutdown_all()
+    net.assert_invariants()
+
+
+def test_kill9_restart_beyond_sync_limit_fast_forwards(tmp_path):
+    """A restarted node that fell beyond sync_limit while dead must
+    catch up through the fast-forward path against its reloaded store
+    and still satisfy every durability invariant."""
+    net = CrashTestnet(4, str(tmp_path), seed=406,
+                       extra_args=["--sync_limit", "30"])
+    victim = net.nodes[0]
+    try:
+        net.start_all()
+        net.wait_up()
+        net.bombard_until(target_round=2, timeout=240.0)
+        victim.kill9()
+        survivors = [n for n in net.nodes if n is not victim]
+        # Push the survivors far enough that the victim trails by more
+        # than sync_limit events when it comes back.
+        net.bombard_until(target_round=net.max_round() + 6,
+                          timeout=300.0, require=survivors)
+        victim.start()
+        net.wait_up([victim])
+        net.bombard_until(target_round=net.max_round() + 2, timeout=300.0)
+        stats = victim.stats()
+        assert int(stats["fast_forwards"]) >= 1, (
+            "victim caught up without fast-forwarding; raise the gap")
+    finally:
+        net.shutdown_all()
+    net.assert_invariants()
+
+
+def test_crash_soak(tmp_path):
+    """The acceptance soak: seeded random SIGKILLs mid-traffic across
+    two kill/restart cycles, then byte-identical block order and
+    exactly-once delivery audits across every node."""
+    result = run_soak(str(tmp_path), n=4, seed=31337, kills=2)
+    assert result["blocks"] > 0
+    assert result["deliveries"] > 0

@@ -1,0 +1,440 @@
+"""Crash-durability unit suite (docs/robustness.md "Crash recovery").
+
+In-process counterpart of tests/test_crash.py: transaction-protocol
+semantics on FileStore (batch atomicity, torn-tail discard, anchors,
+idempotent close), FileStore.load round-trip parity against an
+InmemStore oracle, exactly-once block redelivery across a reload, the
+journal proxy's dedupe, and the node's shutdown drain.
+
+Process death is simulated by closing the raw sqlite connection with a
+transaction open — sqlite discards an uncommitted transaction on
+recovery exactly as it would after SIGKILL (no commit frame in the
+WAL)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+
+import pytest
+
+from babble_tpu.common import StoreError
+from babble_tpu.hashgraph import (
+    Block,
+    FileStore,
+    Hashgraph,
+    InmemStore,
+    RoundInfo,
+)
+from babble_tpu.hashgraph.event import event_from_json_obj
+from babble_tpu.proxy import FileAppProxy
+
+from test_store import make_participants, signed_event
+
+
+def _chain(keys, pubs, per_creator=6, start_ts=1_700_000_000_000_000_000):
+    """A simple two-creator event chain with topo indexes assigned."""
+    heads = {p: "" for p in pubs}
+    events = []
+    ts = start_ts
+    topo = 0
+    for idx in range(per_creator):
+        for k, p in zip(keys, pubs):
+            ev = signed_event(k, p, [heads[p], ""], idx, ts)
+            ts += 1000
+            ev.topological_index = topo
+            topo += 1
+            heads[p] = ev.hex()
+            events.append(ev)
+    return events
+
+
+def _kill(fs: FileStore) -> None:
+    """Simulate SIGKILL: drop the connection with whatever transaction
+    is open; sqlite rolls the uncommitted tail back on next open."""
+    fs._db.close()
+
+
+# ------------------------------------------------- batch atomicity
+
+
+def test_batch_commit_is_atomic_across_kill(tmp_path):
+    keys, pubs, participants = make_participants(2)
+    path = str(tmp_path / "s.db")
+    events = _chain(keys, pubs, per_creator=2)
+
+    fs = FileStore(participants, 100, path)
+    fs.begin_batch()
+    for ev in events[:2]:
+        fs.set_event(ev)
+    fs.commit_batch()          # first sync batch: durable
+    fs.begin_batch()
+    for ev in events[2:]:
+        fs.set_event(ev)
+    _kill(fs)                  # killed mid-second-batch: torn
+
+    fs2 = FileStore.load(100, path)
+    for ev in events[:2]:
+        assert fs2.has_event(ev.hex()), "committed batch lost"
+    for ev in events[2:]:
+        assert not fs2.has_event(ev.hex()), "partial sync batch visible"
+    fs2.close()
+
+
+def test_batch_nesting_commits_once_at_outermost(tmp_path):
+    keys, pubs, participants = make_participants(2)
+    fs = FileStore(participants, 100, str(tmp_path / "n.db"))
+    ev0, ev1 = _chain(keys, pubs, per_creator=1)
+    fs.begin_batch()
+    fs.begin_batch()
+    fs.set_event(ev0)
+    fs.commit_batch()          # inner: must NOT commit yet
+    inner_commits = fs.fsync_count
+    fs.set_event(ev1)
+    fs.commit_batch()          # outermost: one durable commit
+    assert fs.fsync_count == inner_commits + 1
+    fs.close()
+
+    fs2 = FileStore.load(100, str(tmp_path / "n.db"))
+    assert fs2.has_event(ev0.hex()) and fs2.has_event(ev1.hex())
+    fs2.close()
+
+
+def test_rollback_batch_discards_durable_writes(tmp_path):
+    keys, pubs, participants = make_participants(2)
+    path = str(tmp_path / "rb.db")
+    fs = FileStore(participants, 100, path)
+    ev0, ev1 = _chain(keys, pubs, per_creator=1)
+    fs.set_event(ev0)
+    fs.begin_batch()
+    fs.set_event(ev1)
+    fs.rollback_batch()
+    fs.close()
+    fs2 = FileStore.load(100, path)
+    assert fs2.has_event(ev0.hex())
+    assert not fs2.has_event(ev1.hex())
+    fs2.close()
+
+
+def test_torn_consensus_pass_leaves_no_partial_rounds(tmp_path):
+    """Round/block writes of an interrupted pass are invisible after
+    reload: the transaction died with the process, and the load-time
+    recovery additionally discards anything above the consensus
+    anchor."""
+    keys, pubs, participants = make_participants(2)
+    path = str(tmp_path / "t.db")
+    fs = FileStore(participants, 100, path)
+    # one COMPLETE pass: round 0 + block 0, committed atomically
+    ri = RoundInfo()
+    ri.add_event("0xAA", True)
+    fs.begin_batch()
+    fs.set_round(0, ri)
+    fs.set_block(Block(0, [b"tx0"]))
+    fs.commit_batch()
+    assert fs.consensus_anchor() == 0
+    # a second pass interrupted mid-write
+    fs.begin_batch()
+    ri1 = RoundInfo()
+    ri1.add_event("0xBB", True)
+    fs.set_round(1, ri1)
+    fs.set_block(Block(1, [b"tx1"]))
+    _kill(fs)
+
+    fs2 = FileStore.load(100, path)
+    assert fs2.consensus_anchor() == 0
+    assert fs2.get_round(0).events  # complete pass intact
+    assert fs2.get_block(0).transactions == [b"tx0"]
+    with pytest.raises(StoreError):
+        fs2.get_round(1)
+    with pytest.raises(StoreError):
+        fs2.get_block(1)
+    fs2.close()
+
+
+def test_load_discards_rounds_above_anchor(tmp_path):
+    """Defense for pre-transactional writers: rounds/blocks committed
+    per-statement past the anchor (a crafted or legacy tail) are
+    discarded at load so bootstrap recomputes them from events."""
+    keys, pubs, participants = make_participants(2)
+    path = str(tmp_path / "a.db")
+    fs = FileStore(participants, 100, path)
+    ri = RoundInfo()
+    ri.add_event("0xAA", True)
+    fs.set_round(0, ri)        # per-statement commit advances anchor to 0
+    fs.close()
+    # sneak a round + block past the anchor behind FileStore's back
+    db = sqlite3.connect(path)
+    db.execute("INSERT INTO rounds VALUES (7, ?)",
+               (json.dumps({"Events": {}}),))
+    db.execute("INSERT INTO blocks VALUES (7, ?)",
+               (json.dumps({"RoundReceived": 7, "Transactions": []}),))
+    db.commit()
+    db.close()
+
+    fs2 = FileStore.load(100, path)
+    assert fs2.consensus_anchor() == 0
+    with pytest.raises(StoreError):
+        fs2.get_round(7)
+    with pytest.raises(StoreError):
+        fs2.get_block(7)
+    fs2.close()
+
+
+def test_legacy_db_without_meta_migrates(tmp_path):
+    """A database written before the meta table existed loads cleanly:
+    anchors seeded from its content, schema version stamped."""
+    keys, pubs, participants = make_participants(2)
+    path = str(tmp_path / "legacy.db")
+    fs = FileStore(participants, 100, path)
+    ev = _chain(keys, pubs, per_creator=1)[0]
+    fs.set_event(ev)
+    ri = RoundInfo()
+    ri.add_event(ev.hex(), True)
+    fs.set_round(0, ri)
+    fs.set_block(Block(0, [b"tx"]))
+    fs.close()
+    db = sqlite3.connect(path)
+    db.execute("DROP TABLE meta")
+    db.commit()
+    db.close()
+
+    fs2 = FileStore.load(100, path)
+    assert fs2.schema_version() == 2
+    assert fs2.consensus_anchor() == 0
+    # legacy semantics preserved: everything present was treated as
+    # delivered, so a bootstrap re-emits nothing
+    assert fs2.last_committed_block() == 0
+    assert fs2.get_round(0).events
+    fs2.close()
+
+
+# ----------------------------------------------------- close / sync
+
+
+def test_close_is_idempotent_and_exception_safe(tmp_path):
+    keys, pubs, participants = make_participants(2)
+    path = str(tmp_path / "c.db")
+    fs = FileStore(participants, 100, path)
+    ev = _chain(keys, pubs, per_creator=1)[0]
+    fs.set_event(ev)
+    fs.close()
+    fs.close()                 # double close: no raise
+    fs.close()
+
+    # close with an interrupted batch open: rolled back, no raise
+    fs2 = FileStore.load(100, path)
+    ev2 = _chain(keys, pubs, per_creator=2)[3]
+    fs2.begin_batch()
+    fs2.set_event(ev2)
+    fs2.close()
+    fs2.close()
+    fs3 = FileStore.load(100, path)
+    assert fs3.has_event(ev.hex())
+    assert not fs3.has_event(ev2.hex()), (
+        "half-open batch committed by close")
+    fs3.close()
+    # writes after close never raise out of the durable marker path
+    fs3.set_last_committed_block(99)
+
+
+@pytest.mark.parametrize("sync,level", [("always", 2), ("batch", 1),
+                                        ("off", 0)])
+def test_store_sync_policy_sets_pragma(tmp_path, sync, level):
+    _, _, participants = make_participants(2)
+    fs = FileStore(participants, 10, str(tmp_path / f"{sync}.db"),
+                   sync=sync)
+    assert fs._db.execute("PRAGMA synchronous").fetchone()[0] == level
+    assert fs.durability_stats()["store_sync"] == sync
+    fs.close()
+
+
+def test_store_sync_rejects_unknown_policy(tmp_path):
+    _, _, participants = make_participants(2)
+    with pytest.raises(ValueError):
+        FileStore(participants, 10, str(tmp_path / "x.db"), sync="fsync")
+
+
+def test_durability_stats_counts_commits(tmp_path):
+    keys, pubs, participants = make_participants(2)
+    fs = FileStore(participants, 100, str(tmp_path / "d.db"))
+    before = fs.durability_stats()["fsync_count"]
+    for ev in _chain(keys, pubs, per_creator=2):
+        fs.set_event(ev)
+    d = fs.durability_stats()
+    assert d["fsync_count"] == before + 4
+    assert d["fsync_total_ns"] > 0
+    assert d["last_committed_block"] == -1
+    fs.set_last_committed_block(3)
+    assert fs.durability_stats()["last_committed_block"] == 3
+    fs.close()
+
+
+# ------------------------------------- load parity vs inmem oracle
+
+
+def test_file_store_load_parity_with_inmem_oracle(tmp_path):
+    """Persist a converged hashgraph, reload + bootstrap, and hold
+    every read surface to an InmemStore oracle that ran the identical
+    DAG: known, rounds, witnesses, blocks, event-object windows."""
+    from fixtures import build_consensus_graph
+
+    h, b = build_consensus_graph()
+    participants = b.participants()
+    path = str(tmp_path / "parity.db")
+
+    fs = FileStore(participants, 1000, path)
+    h_file = Hashgraph(participants, fs)
+    oracle_store = InmemStore(participants, 1000)
+    h_oracle = Hashgraph(participants, oracle_store)
+    for ev in b.ordered_events:
+        for target in (h_file, h_oracle):
+            target.insert_event(
+                event_from_json_obj(json.loads(ev.marshal())), True)
+    h_file.run_consensus()
+    h_oracle.run_consensus()
+    fs.close()
+
+    fs2 = FileStore.load(1000, path)
+    h2 = Hashgraph(participants, fs2)
+    h2.bootstrap()
+
+    assert fs2.known() == oracle_store.known()
+    assert h2.consensus_events() == h_oracle.consensus_events()
+    assert h2.last_consensus_round == h_oracle.last_consensus_round
+    assert fs2.last_round() == oracle_store.last_round()
+    for r in range(oracle_store.last_round() + 1):
+        want = oracle_store.get_round(r)
+        got = fs2.get_round(r)
+        assert sorted(got.witnesses()) == sorted(want.witnesses()), r
+        assert {x: (e.witness, e.famous) for x, e in got.events.items()} \
+            == {x: (e.witness, e.famous) for x, e in want.events.items()}, r
+        want_block = None
+        try:
+            want_block = oracle_store.get_block(r)
+        except StoreError:
+            pass
+        if want_block is not None:
+            assert fs2.get_block(r).marshal() == want_block.marshal(), r
+    for pk in participants:
+        want_objs = oracle_store.participant_event_objects(pk, -1)
+        got_objs = fs2.participant_event_objects(pk, -1)
+        assert [e.hex() for e in got_objs] == [e.hex() for e in want_objs]
+        assert [e.topological_index for e in got_objs] \
+            == [e.topological_index for e in want_objs]
+        assert fs2.last_from(pk) == oracle_store.last_from(pk)
+    fs2.close()
+
+
+# -------------------------------------------- exactly-once redelivery
+
+
+def test_bootstrap_redelivers_only_above_durable_anchor(tmp_path):
+    """Blocks at or below last_committed_block were delivered before
+    the crash and must NOT re-emit; blocks above it (decided, never
+    durably delivered) must re-emit byte-identically."""
+    from fixtures import build_consensus_graph
+
+    h, b = build_consensus_graph()
+    participants = b.participants()
+    path = str(tmp_path / "eo.db")
+
+    committed = []
+    fs = FileStore(participants, 1000, path)
+    h1 = Hashgraph(participants, fs, commit_callback=committed.append)
+    for ev in b.ordered_events:
+        h1.insert_event(
+            event_from_json_obj(json.loads(ev.marshal())), True)
+    h1.run_consensus()
+    assert committed, "fixture must commit a block"
+    # the crash beat every delivery to the durable marker: the anchor
+    # is still -1, so the reload must re-emit the whole committed tail
+    # byte-identically
+    fs.close()
+
+    redelivered = []
+    fs2 = FileStore.load(1000, path)
+    h2 = Hashgraph(participants, fs2, commit_callback=redelivered.append)
+    h2.bootstrap()
+    assert [blk.marshal() for blk in redelivered] \
+        == [blk.marshal() for blk in committed]
+    fs2.close()
+
+    # fully-delivered store: a reload re-emits nothing
+    fs3 = FileStore.load(1000, path)
+    fs3.set_last_committed_block(committed[-1].round_received)
+    silent = []
+    h3 = Hashgraph(participants, fs3, commit_callback=silent.append)
+    h3.bootstrap()
+    assert silent == []
+    assert h3.consensus_events() == h1.consensus_events()
+    fs3.close()
+
+
+# ------------------------------------------------- journal app proxy
+
+
+def test_file_app_proxy_journal_and_restart_dedupe(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    p1 = FileAppProxy(path)
+    p1.commit_block(Block(3, [b"a", b"b"]))
+    p1.commit_block(Block(5, [b"c"]))
+    assert p1.last_round() == 5
+    assert p1.committed_transactions() == [b"a", b"b", b"c"]
+    p1.close()
+
+    # restart: redelivery at/below the journal tail is dropped,
+    # new blocks append
+    p2 = FileAppProxy(path)
+    assert p2.last_round() == 5
+    p2.commit_block(Block(5, [b"c"]))      # crash-window redelivery
+    p2.commit_block(Block(4, [b"stale"]))  # below tail
+    p2.commit_block(Block(7, [b"d"]))
+    assert p2.committed_transactions() == [b"a", b"b", b"c", b"d"]
+    p2.close()
+
+    with open(path) as fh:
+        rounds = [json.loads(line)["round"] for line in fh]
+    assert rounds == [3, 5, 7]
+
+
+def test_file_app_proxy_ignores_torn_final_line(tmp_path):
+    path = str(tmp_path / "torn.jsonl")
+    p1 = FileAppProxy(path)
+    p1.commit_block(Block(2, [b"a"]))
+    p1.close()
+    with open(path, "a") as fh:
+        fh.write('{"round": 9, "txs": ["ff')  # killed mid-write
+    p2 = FileAppProxy(path)
+    assert p2.last_round() == 2
+    p2.commit_block(Block(3, [b"b"]))  # continues past the torn line
+    assert p2.committed_transactions() == [b"a", b"b"]
+    p2.close()
+
+
+# --------------------------------------------------- shutdown drain
+
+
+def test_shutdown_drains_queued_blocks(tmp_path):
+    """Blocks the consensus worker decided but the background worker
+    never delivered are delivered (and durably marked) by shutdown
+    instead of dropped on the floor."""
+    from babble_tpu.net import InmemTransport
+    from babble_tpu.node import Node
+    from babble_tpu.node.config import test_config
+    from babble_tpu.proxy import InmemAppProxy
+
+    from test_node import make_keyed_peers
+
+    entries = make_keyed_peers(1)
+    key, peer = entries[0]
+    participants = {peer.pub_key_hex: 0}
+    store = InmemStore(participants, 1000)
+    proxy = InmemAppProxy()
+    node = Node(test_config(), 0, key, [peer],
+                store, InmemTransport(peer.net_addr), proxy)
+    node.init()
+    node.commit_ch.put(Block(1, [b"queued"]))
+    node.shutdown()
+    assert proxy.committed_transactions() == [b"queued"]
+    assert store.last_committed_block() == 1

@@ -73,5 +73,28 @@ def test_stats_endpoint():
             raise AssertionError("bad seconds accepted")
         except urllib.error.HTTPError as err:
             assert err.code == 400
+
+        # durability view (docs/robustness.md "Crash recovery"): the
+        # inmem store reports its type + in-memory delivered anchor
+        assert stats["store_type"] == "inmem"
+        assert "last_committed_block" in stats
+
+        # POST /submit: transaction intake without a socket client
+        # (crash-harness mode). The tx must reach consensus.
+        req = urllib.request.Request(
+            f"http://{service.addr}/submit",
+            data=b"service submitted tx", method="POST")
+        with urllib.request.urlopen(req, timeout=2) as r:
+            assert r.status == 200
+            assert json.loads(r.read())["submitted"] == len(
+                b"service submitted tx")
+        try:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://{service.addr}/submit", data=b"",
+                    method="POST"), timeout=2)
+            raise AssertionError("empty tx accepted")
+        except urllib.error.HTTPError as err:
+            assert err.code == 400
     finally:
         service.close()
