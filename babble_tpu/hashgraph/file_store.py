@@ -32,7 +32,7 @@ import threading
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
-from ..common import StoreError, StoreErrType
+from ..common import StoreError, StoreErrType, is_store_err
 from ..gojson import Timestamp, ZERO_TIME
 from .block import Block
 from .event import Event, EventCoordinates, event_from_json_obj
@@ -415,7 +415,24 @@ class FileStore:
         return row is not None
 
     def set_event(self, event: Event) -> None:
-        self.inmem.set_event(event)
+        try:
+            self.inmem.set_event(event)
+        except StoreError as err:
+            if not is_store_err(err, StoreErrType.PASSED_INDEX):
+                raise
+            # The rolling window aged past this index and the hot LRU
+            # no longer holds the hash, so the cache cannot tell an
+            # idempotent refresh from a fork — but the db can: an
+            # identical hash at (creator, idx) is a refresh and falls
+            # through to the upsert below; anything else is a genuine
+            # fork.
+            with self._lock:
+                row = self._db.execute(
+                    "SELECT hex FROM events WHERE creator = ? AND idx = ?",
+                    (event.creator(), event.index()),
+                ).fetchone()
+            if row is None or row[0] != event.hex():
+                raise
         obj = json.loads(event.marshal())
         with self._lock:
             # Replay order is the autoincrement seq (stable across

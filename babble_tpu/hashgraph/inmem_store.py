@@ -89,7 +89,18 @@ class InmemStore:
             except StoreError as err:
                 if not is_store_err(err, StoreErrType.TOO_LATE):
                     raise
-                existing = key  # aged out of the window: trust the caller
+                # Aged out of the rolling window: the window can no
+                # longer vouch for which hash lived at this index, so
+                # only a hash we have already stored is an idempotent
+                # refresh — an unknown hash at a passed index is
+                # indistinguishable from a fork and must not be
+                # silently absorbed (FileStore falls back to its db
+                # for the authoritative answer).
+                _, known = self.event_cache.get(key)
+                if not known:
+                    raise StoreError(
+                        StoreErrType.PASSED_INDEX, key) from err
+                existing = key
             if existing != key:
                 raise StoreError(StoreErrType.PASSED_INDEX, key)
         self.event_cache.add(key, event)

@@ -135,7 +135,23 @@ class Node:
 
     def init(self, bootstrap: bool = False) -> None:
         if bootstrap:
-            self.core.bootstrap()
+            # Bootstrap's torn-tail replay re-emits every undelivered
+            # block through the commit callback — normally
+            # commit_ch.put on a queue bounded at 400 with no consumer
+            # running yet, so a backlog longer than the queue would
+            # block init forever. Swap in a local buffer for the
+            # replay, then deliver the tail synchronously (in order,
+            # advancing the durable anchor) before gossip starts.
+            replayed: List[Block] = []
+            hg = self.core.hg
+            saved_cb = hg.commit_callback
+            hg.commit_callback = replayed.append
+            try:
+                self.core.bootstrap()
+            finally:
+                hg.commit_callback = saved_cb
+            for block in replayed:
+                self._commit(block)
         else:
             self.core.init()
 
@@ -180,9 +196,13 @@ class Node:
         # (now stopped) background worker never delivered would
         # otherwise be dropped on the floor — deliver them so the app
         # and the durable delivered marker agree with the store before
-        # it closes (the commit_ch forwarder may have moved some onto
-        # _work; drain both).
-        for q in (self.commit_ch, self._work):
+        # it closes. The commit_ch forwarder moves blocks commit_ch ->
+        # _work, so _work holds the OLDER blocks: drain in delivery
+        # order (_work first), else the newer blocks advance the
+        # durable anchor and the app's last-round dedupe past the
+        # older ones, which then get silently dropped — their
+        # transactions lost.
+        for q in (self._work, self.commit_ch):
             while True:
                 try:
                     item = q.get_nowait()

@@ -20,6 +20,10 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+# /submit has no authentication (localhost-binding is the documented
+# guard), so at least bound what one request can make the node buffer.
+_MAX_SUBMIT_BYTES = 1 << 20
+
 
 class Service:
     def __init__(self, bind_addr: str, node):
@@ -153,6 +157,25 @@ class Service:
                     # service_addr to localhost in production.
                     try:
                         length = int(self.headers.get("Content-Length", 0))
+                        if length <= 0:
+                            self._json(400, {"error": "empty transaction"})
+                            return
+                        if length > _MAX_SUBMIT_BYTES:
+                            # Drain and discard in bounded chunks:
+                            # responding with the body unread breaks
+                            # the client's pipe mid-send, and memory
+                            # must stay capped either way.
+                            remaining = length
+                            while remaining > 0:
+                                chunk = self.rfile.read(
+                                    min(remaining, 65536))
+                                if not chunk:
+                                    break
+                                remaining -= len(chunk)
+                            self._json(413, {"error": "transaction too "
+                                             f"large (max {_MAX_SUBMIT_BYTES}"
+                                             " bytes)"})
+                            return
                         tx = self.rfile.read(length)
                         if not tx:
                             self._json(400, {"error": "empty transaction"})

@@ -438,3 +438,81 @@ def test_shutdown_drains_queued_blocks(tmp_path):
     node.shutdown()
     assert proxy.committed_transactions() == [b"queued"]
     assert store.last_committed_block() == 1
+
+
+def test_shutdown_drains_in_delivery_order(tmp_path):
+    """The commit_ch forwarder moves blocks commit_ch -> _work, so at
+    shutdown _work holds the OLDER undelivered blocks. Draining
+    commit_ch first would advance the durable anchor and a journaling
+    proxy's dedupe line past them, silently dropping their
+    transactions — the drain must deliver _work first."""
+    from babble_tpu.net import InmemTransport
+    from babble_tpu.node import Node
+    from babble_tpu.node.config import test_config
+
+    from test_node import make_keyed_peers
+
+    entries = make_keyed_peers(1)
+    key, peer = entries[0]
+    participants = {peer.pub_key_hex: 0}
+    store = InmemStore(participants, 1000)
+    proxy = FileAppProxy(str(tmp_path / "drain.jsonl"))
+    node = Node(test_config(), 0, key, [peer],
+                store, InmemTransport(peer.net_addr), proxy)
+    node.init()
+    node._work.put(("block", Block(1, [b"older"])))  # forwarded earlier
+    node.commit_ch.put(Block(2, [b"newer"]))         # still in commit_ch
+    node.shutdown()
+    assert proxy.committed_transactions() == [b"older", b"newer"]
+    assert store.last_committed_block() == 2
+    proxy.close()
+
+
+def test_node_bootstrap_replay_does_not_route_through_commit_ch(tmp_path):
+    """commit_ch is bounded (400) and its consumer only starts in
+    run(): a torn-tail replay longer than the bound would deadlock
+    init if re-emitted blocks were put on the queue. The node must
+    buffer the replay and deliver it synchronously during init."""
+    from babble_tpu.net import InmemTransport
+    from babble_tpu.net.peer import Peer
+    from babble_tpu.node import Node
+    from babble_tpu.node.config import test_config
+    from babble_tpu.proxy import InmemAppProxy
+
+    from fixtures import CONSENSUS_PLAYS, GraphBuilder
+
+    path = str(tmp_path / "replay.db")
+    committed = []
+    # Converge a DAG into a FileStore whose durable anchor never
+    # advanced: the whole committed tail is undelivered at "crash".
+    b = GraphBuilder(3)
+    for i in range(3):
+        b.add_initial(f"e{i}", i)
+    for p in CONSENSUS_PLAYS:
+        b.play(p)
+    participants = b.participants()
+    fs = FileStore(participants, 1000, path)
+    h1 = Hashgraph(participants, fs, commit_callback=committed.append)
+    for ev in b.ordered_events:
+        h1.insert_event(ev, True)
+    h1.run_consensus()
+    assert committed, "fixture must leave an undelivered block tail"
+    fs.close()
+
+    fs2 = FileStore.load(1000, path)
+    peers = [Peer(net_addr=f"addr{n.id}", pub_key_hex=n.pub_hex)
+             for n in b.nodes]
+    proxy = InmemAppProxy()
+    node = Node(test_config(), 0, b.nodes[0].key, peers, fs2,
+                InmemTransport("addr0"), proxy)
+
+    def no_queue_put(block):
+        raise AssertionError(
+            "bootstrap replay must not route through commit_ch")
+
+    node.core.hg.commit_callback = no_queue_put
+    node.init(bootstrap=True)
+    want = [tx for blk in committed for tx in (blk.transactions or [])]
+    assert proxy.committed_transactions() == want
+    assert fs2.last_committed_block() == committed[-1].round_received
+    fs2.close()

@@ -96,5 +96,15 @@ def test_stats_endpoint():
             raise AssertionError("empty tx accepted")
         except urllib.error.HTTPError as err:
             assert err.code == 400
+        # the unauthenticated intake caps the body it will buffer
+        try:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://{service.addr}/submit",
+                    data=b"x" * ((1 << 20) + 1), method="POST"),
+                timeout=5)
+            raise AssertionError("oversized tx accepted")
+        except urllib.error.HTTPError as err:
+            assert err.code == 413
     finally:
         service.close()

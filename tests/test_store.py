@@ -268,3 +268,59 @@ def test_file_store_reload_serves_evicted_history(tmp_path):
     assert fs2.participant_events(pubs[0], -1) == [
         e.hex() for e in evs[pubs[0]]]
     fs2.close()
+
+
+def _chain(keys, pubs, store, n, start_ts=10**18):
+    """Insert an n-event self-parent chain for participant 0."""
+    evs, prev = [], ""
+    for i in range(n):
+        ev = signed_event(keys[0], pubs[0], [prev, ""], i, start_ts + i)
+        store.set_event(ev)
+        evs.append(ev)
+        prev = ev.hex()
+    return evs
+
+
+def test_inmem_passed_index_rejects_unknown_hash_beyond_window():
+    """An event reusing an index that aged out of the rolling window is
+    NOT absorbed as an idempotent refresh: once neither the window nor
+    the LRU can vouch for the hash previously stored there, a differing
+    hash is indistinguishable from a fork and must raise PASSED_INDEX."""
+    keys, pubs, participants = make_participants(1)
+    store = InmemStore(participants, 5)
+    evs = _chain(keys, pubs, store, 12)
+
+    # index 0 aged out of the window AND its hash fell out of the LRU
+    with pytest.raises(StoreError) as ei:
+        store.participant_event(pubs[0], 0)
+    assert is_store_err(ei.value, StoreErrType.TOO_LATE)
+    assert not store.event_cache.get(evs[0].hex())[1]
+
+    # a DIFFERENT event at that index (a fork on old history) raises
+    forged = signed_event(keys[0], pubs[0], ["", ""], 0, 10**18 + 999)
+    assert forged.hex() != evs[0].hex()
+    with pytest.raises(StoreError) as ei:
+        store.set_event(forged)
+    assert is_store_err(ei.value, StoreErrType.PASSED_INDEX)
+
+    # a re-store the cache can still vouch for stays idempotent
+    store.set_event(evs[11])
+    assert store.participant_event(pubs[0], 11) == evs[11].hex()
+
+
+def test_file_store_passed_index_falls_back_to_db(tmp_path):
+    """FileStore answers the beyond-window re-store from its database:
+    the hash on disk at (creator, idx) distinguishes an idempotent
+    refresh (accepted) from a fork (PASSED_INDEX)."""
+    keys, pubs, participants = make_participants(1)
+    fs = FileStore(participants, 5, os.path.join(tmp_path, "pi.db"))
+    evs = _chain(keys, pubs, fs, 12)
+
+    # genuine re-store of old history: db vouches, no raise
+    fs.set_event(evs[0])
+
+    forged = signed_event(keys[0], pubs[0], ["", ""], 0, 10**18 + 999)
+    with pytest.raises(StoreError) as ei:
+        fs.set_event(forged)
+    assert is_store_err(ei.value, StoreErrType.PASSED_INDEX)
+    fs.close()
