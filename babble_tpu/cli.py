@@ -60,6 +60,15 @@ def cmd_run(args) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
     logger = logging.getLogger("babble_tpu")
+    json_fmt = None
+    if args.log_format == "json":
+        # Structured logs (docs/observability.md): one JSON object per
+        # line with node-id and span-id fields, so multi-node harness
+        # logs merge into one machine-sortable stream. The node id is
+        # backfilled below once the key identifies us.
+        from .telemetry import use_json_logging
+
+        json_fmt = use_json_logging(logging.getLogger())
 
     if args.engine == "tpu":
         # Persistent XLA compile cache: a restarting node (and every
@@ -82,6 +91,8 @@ def cmd_run(args) -> int:
               file=sys.stderr)
         return 1
     node_id = pmap[my_pub]
+    if json_fmt is not None:
+        json_fmt.node_id = node_id
 
     conf = Config(
         heartbeat_timeout=args.heartbeat / 1000.0,
@@ -105,6 +116,7 @@ def cmd_run(args) -> int:
         sync_retries=args.sync_retries,
         engine_failover_threshold=(
             0 if args.no_failover else args.engine_failover_threshold),
+        trace_ring=args.trace_ring,
         logger=logger,
     )
 
@@ -204,6 +216,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="IP:Port to bind the HTTP service")
     rn.add_argument("--log_level", default="info",
                     help="debug, info, warn, error")
+    rn.add_argument("--log_format", default="text",
+                    choices=["text", "json"],
+                    help="text = human-readable lines; json = one "
+                         "structured JSON object per line with node-id "
+                         "and span-id fields (machine-mergeable across "
+                         "a multi-node harness)")
+    rn.add_argument("--trace_ring", type=int, default=4096,
+                    help="span ring capacity behind /debug/trace "
+                         "(last N sync/consensus/commit spans as "
+                         "Perfetto-loadable Chrome trace JSON; 0 "
+                         "disables)")
     rn.add_argument("--heartbeat", type=int, default=1000,
                     help="heartbeat timer in milliseconds")
     rn.add_argument("--max_pool", type=int, default=2,

@@ -135,6 +135,16 @@ class FileStore:
         self.fsync_count = 0
         self.fsync_total_ns = 0
         self.fsync_last_ns = 0
+        from ..telemetry import get_registry
+
+        _reg = get_registry()
+        self._m_fsync = _reg.histogram(
+            "babble_store_fsync_seconds",
+            "Store batch-commit wall seconds (WAL write + fsync)",
+            sync=sync)
+        self._m_fsyncs = _reg.counter(
+            "babble_store_fsyncs_total",
+            "Store batch commits (WAL write + fsync)", sync=sync)
         exists = os.path.exists(path)
         if not exists and not create:
             raise StoreError(StoreErrType.KEY_NOT_FOUND, path)
@@ -337,6 +347,11 @@ class FileStore:
         self.fsync_count += 1
         self.fsync_total_ns += dt
         self.fsync_last_ns = dt
+        # Registry mirror (docs/observability.md): the batch-commit
+        # wall (WAL write + fsync) as a latency distribution, and the
+        # commit count, labeled by the fsync policy.
+        self._m_fsync.observe(dt / 1e9)
+        self._m_fsyncs.inc()
 
     def wal_bytes(self) -> int:
         try:

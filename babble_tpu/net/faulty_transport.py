@@ -86,10 +86,23 @@ class FaultyTransport:
         self._closed = threading.Event()
         self._rngs: Dict[str, random.Random] = {}
         self._lock = threading.Lock()
-        # Injection counters — test/observability surface.
+        # Injection counters — test/observability surface. Mirrored
+        # into the process-global telemetry registry so a /metrics
+        # scrape of a chaos-wrapped node shows what the fault plan
+        # actually injected (docs/observability.md).
         self.injected = {"drop": 0, "delay": 0, "duplicate": 0,
                          "partitioned": 0, "crashed": 0,
                          "inbound_crashed": 0}
+        from ..telemetry import get_registry
+
+        _reg = get_registry()
+        addr = inner.local_addr()
+        self._m_injected = {
+            kind: _reg.counter(
+                "babble_transport_faults_total",
+                "Chaos-transport injected faults", addr=addr, kind=kind)
+            for kind in self.injected
+        }
         # Own consumer queue fed by a pump thread: the crash gate must
         # intercept INBOUND RPCs too (peers enqueue straight onto the
         # inner transport), answering them with an error so callers
@@ -133,6 +146,10 @@ class FaultyTransport:
 
     # -- fault application --------------------------------------------------
 
+    def _inject(self, kind: str) -> None:
+        self.injected[kind] += 1
+        self._m_injected[kind].inc()
+
     def _spec_rng(self, target: str):
         with self._lock:
             spec = self._per_target.get(target, self._default)
@@ -148,19 +165,19 @@ class FaultyTransport:
 
     def _apply(self, target: str) -> tuple:
         if self._crashed.is_set():
-            self.injected["crashed"] += 1
+            self._inject("crashed")
             raise TransportError("crashed (injected)")
         with self._lock:
             blocked = target in self._blocked
         if blocked:
-            self.injected["partitioned"] += 1
+            self._inject("partitioned")
             raise TransportError(f"partitioned from {target} (injected)")
         spec, rng = self._spec_rng(target)
         if spec.drop > 0.0 and rng.random() < spec.drop:
-            self.injected["drop"] += 1
+            self._inject("drop")
             raise TransportError(f"dropped rpc to {target} (injected)")
         if spec.delay_max > 0.0:
-            self.injected["delay"] += 1
+            self._inject("delay")
             time.sleep(rng.uniform(spec.delay_min, spec.delay_max))
         return spec, rng
 
@@ -183,7 +200,7 @@ class FaultyTransport:
         if spec.duplicate > 0.0 and rng.random() < spec.duplicate:
             # At-least-once delivery: the duplicate's outcome is
             # irrelevant (the first one already succeeded).
-            self.injected["duplicate"] += 1
+            self._inject("duplicate")
             try:
                 self._inner.eager_sync(target, args)
             except TransportError:
@@ -210,7 +227,7 @@ class FaultyTransport:
             except queue.Empty:
                 continue
             if self._crashed.is_set():
-                self.injected["inbound_crashed"] += 1
+                self._inject("inbound_crashed")
                 rpc.respond(None, TransportError("peer crashed (injected)"))
                 continue
             self._consumer.put(rpc)

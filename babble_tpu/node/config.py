@@ -104,6 +104,12 @@ class Config:
     # wedged device engine then just logs every interval, the pre-PR-2
     # behavior).
     engine_failover_threshold: int = 3
+    # -- telemetry (docs/observability.md) -----------------------------
+    # Capacity of the span ring buffer behind /debug/trace: the last N
+    # sync / consensus-pass / commit / fast-forward / failover spans,
+    # exported as Perfetto-loadable Chrome trace JSON. One deque append
+    # per span — cheap enough to leave on; 0 disables recording.
+    trace_ring: int = 4096
     logger: logging.Logger = field(default_factory=_default_logger)
 
 

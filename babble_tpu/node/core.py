@@ -20,6 +20,7 @@ from ..hashgraph.block import Block
 from ..hashgraph.event import Event, WireEvent
 from ..hashgraph.graph import Hashgraph
 from ..hashgraph.store import Store
+from ..telemetry import Registry, SpanRing, get_registry
 from .ingest import resolve_verify_workers, verify_events
 
 
@@ -36,6 +37,8 @@ class Core:
         engine_prewarm: bool = False,
         engine_opts: Optional[Dict] = None,
         verify_workers: int = -1,
+        trace: Optional[SpanRing] = None,
+        registry: Optional[Registry] = None,
     ):
         self.id = id
         self.key = key
@@ -116,6 +119,27 @@ class Core:
         # phase -> (last ns, total ns, calls); written only under the
         # node's core lock, like every other Core mutation.
         self.phase_ns: Dict[str, List[int]] = {}
+        # Telemetry (docs/observability.md): per-phase wall-clock
+        # DISTRIBUTIONS (phase_ns keeps only last/total/calls) and the
+        # full consensus-pass wall — for the pipelined device engine
+        # that is dispatch -> collect across worker wakes, stamped on
+        # the PendingPass itself. The span ring records sync /
+        # consensus-pass / failover spans for /debug/trace; a no-op
+        # ring when the owner (tests constructing Core bare) passes
+        # none.
+        self.trace = trace if trace is not None else SpanRing(0)
+        # The owning Node shares its per-node registry; a Core built
+        # bare (tests, tools) records into the process-global one.
+        self._registry = registry if registry is not None else get_registry()
+        self._node_label = str(id)
+        self._phase_hist: Dict[str, object] = {}
+        self._m_pass = self._registry.histogram(
+            "babble_engine_pass_seconds",
+            "Consensus pass wall seconds (device: dispatch->collect)",
+            node=self._node_label)
+        self._m_failovers = self._registry.counter(
+            "babble_engine_failovers_total",
+            "Device->host engine failovers", node=self._node_label)
 
     def _timed(self, phase: str, t0: int) -> None:
         dt = time.perf_counter_ns() - t0
@@ -123,6 +147,13 @@ class Core:
         ent[0] = dt
         ent[1] += dt
         ent[2] += 1
+        hist = self._phase_hist.get(phase)
+        if hist is None:
+            hist = self._registry.histogram(
+                "babble_phase_seconds", "Per-phase wall seconds",
+                node=self._node_label, phase=phase)
+            self._phase_hist[phase] = hist
+        hist.observe(dt / 1e9)
 
     def pub_key(self) -> bytes:
         if self._pub_key is None:
@@ -289,6 +320,12 @@ class Core:
         insert loop's has_event re-check."""
         t_sync = time.perf_counter_ns()
 
+        with self.trace.span("sync", cat="sync", batch=len(unknown)):
+            self._sync_batch(unknown, unlocked)
+        self._merge_store_phases()
+        self._timed("sync", t_sync)
+
+    def _sync_batch(self, unknown: List[WireEvent], unlocked=None) -> None:
         t0 = time.perf_counter_ns()
         events = self.hg.read_wire_batch(unknown)
         self._timed("from_wire", t0)
@@ -339,8 +376,6 @@ class Core:
                 self.transaction_pool = []
         finally:
             store.commit_batch()
-        self._merge_store_phases()
-        self._timed("sync", t_sync)
 
     def add_self_event(self) -> None:
         """Wrap a non-empty tx pool in a new self-event — reference
@@ -364,8 +399,11 @@ class Core:
 
     def run_consensus(self, unlocked=None) -> None:
         t0 = time.perf_counter_ns()
-        self.hg.run_consensus(unlocked=unlocked)
+        with self.trace.span("consensus_pass", cat="consensus",
+                             engine=self.engine_state):
+            self.hg.run_consensus(unlocked=unlocked)
         self._timed("run_consensus", t0)
+        self._m_pass.observe((time.perf_counter_ns() - t0) / 1e9)
         self._merge_engine_phases()
         self._merge_store_phases()
 
@@ -382,8 +420,17 @@ class Core:
         PendingPass immediately (None when there is nothing to do) —
         no device round trip happens here."""
         t0 = time.perf_counter_ns()
-        pending = self.hg.dispatch_consensus(unlocked=unlocked)
+        with self.trace.span("consensus_dispatch", cat="consensus"):
+            pending = self.hg.dispatch_consensus(unlocked=unlocked)
         self._timed("consensus_dispatch", t0)
+        if pending is not None:
+            # Stamp so collect_consensus can observe the TRUE pass
+            # wall — dispatch to collect across worker wakes, which no
+            # single phase timer sees in pipelined mode.
+            try:
+                pending._dispatch_ns = t0
+            except AttributeError:
+                pass  # slotted PendingPass: skip the wall metric
         return pending
 
     def collect_consensus(self, pending, unlocked=None) -> None:
@@ -392,8 +439,13 @@ class Core:
         if pending is None:
             return
         t0 = time.perf_counter_ns()
-        self.hg.collect_consensus(pending, unlocked=unlocked)
+        with self.trace.span("consensus_collect", cat="consensus",
+                             engine=self.engine_state):
+            self.hg.collect_consensus(pending, unlocked=unlocked)
         self._timed("consensus_collect", t0)
+        end = time.perf_counter_ns()
+        self._m_pass.observe(
+            (end - getattr(pending, "_dispatch_ns", t0)) / 1e9)
         self._merge_engine_phases()
         self._merge_store_phases()
 
@@ -428,6 +480,12 @@ class Core:
         old = self.hg
         if not hasattr(old, "dispatch_consensus"):
             return  # already on the host engine
+        with self.trace.span("failover", cat="consensus"):
+            self._failover_to_host()
+        self._m_failovers.inc()
+
+    def _failover_to_host(self) -> None:
+        old = self.hg
         old_store = old.store
         old_lcr = old.last_consensus_round
 

@@ -47,6 +47,7 @@ from ..net.transport import (
     TransportError,
 )
 from ..proxy.proxy import AppProxy
+from ..telemetry import Registry, SpanRing
 from .config import Config
 from .control_timer import ControlTimer
 from .core import Core
@@ -71,6 +72,54 @@ class Node:
         self.local_addr = trans.local_addr()
 
         self.commit_ch: "queue.Queue[Block]" = queue.Queue(400)
+        # Telemetry (docs/observability.md): the span ring behind
+        # /debug/trace, and this node's metric children behind
+        # /metrics. The registry is PER NODE (merged with the
+        # process-global one — store, transports — at scrape time) so
+        # a fresh node's counters start at zero even in a long-lived
+        # multi-node test process. The scattered ad-hoc counters this
+        # node used to keep, each with its own locking story, live
+        # here now.
+        self.trace = SpanRing(getattr(conf, "trace_ring", 4096))
+        self.registry = Registry()
+        _nl = str(id)
+        reg = self.registry
+        self._m_sync_requests = reg.counter(
+            "babble_sync_requests_total",
+            "Outbound gossip requests (pull + push legs)", node=_nl)
+        self._m_sync_errors = reg.counter(
+            "babble_sync_errors_total",
+            "Failed outbound gossip requests", node=_nl)
+        self._m_sync_retries = reg.counter(
+            "babble_sync_retries_total",
+            "Gossip pull retries after a transport failure", node=_nl)
+        self._m_fast_forwards = reg.counter(
+            "babble_fast_forwards_total",
+            "Completed fast-sync catch-ups", node=_nl)
+        self._m_blocks = reg.counter(
+            "babble_commit_blocks_total",
+            "Blocks delivered to the app proxy", node=_nl)
+        self._m_txs_committed = reg.counter(
+            "babble_commit_txs_total",
+            "Transactions delivered inside committed blocks", node=_nl)
+        self._m_txs_submitted = reg.counter(
+            "babble_submitted_txs_total",
+            "Transactions accepted into the pool", node=_nl)
+        self._m_commit_latency = reg.histogram(
+            "babble_commit_latency_seconds",
+            "Transaction submit -> CommitBlock delivery latency",
+            node=_nl)
+        self._node_label = _nl
+        self._rtt_hists: Dict = {}
+        # Submit->commit stamping: intake monotonic time per tx
+        # payload, bounded (insertion-ordered dict; the oldest stamp
+        # is evicted at the cap, so an abandoned tx cannot leak its
+        # stamp forever). Keyed by the raw bytes — a byte-identical
+        # resubmit keeps the FIRST stamp, so the histogram reports the
+        # full wait of the earliest submitter.
+        self._tx_stamps: "Dict[bytes, float]" = {}
+        self._tx_stamp_cap = 8192
+        self._tx_stamp_lock = threading.Lock()
         pmap = store.participants()
         self.core = Core(
             id, key, pmap, store,
@@ -80,6 +129,8 @@ class Node:
             engine_prewarm=getattr(conf, "engine_prewarm", False),
             engine_opts=getattr(conf, "engine_opts", None),
             verify_workers=getattr(conf, "verify_workers", -1),
+            trace=self.trace,
+            registry=self.registry,
         )
         self.core_lock = threading.Lock()
         # At most two gossip rounds in flight (see _babble).
@@ -111,10 +162,10 @@ class Node:
         self._shutdown = threading.Event()
 
         self.start_time = time.monotonic()
-        self.sync_requests = 0
-        self.sync_errors = 0
-        self.fast_forwards = 0
-        self._stats_lock = threading.Lock()  # counters hit by gossip + RPC threads
+        # Kept only as the shutdown-once guard; the gossip counters it
+        # used to protect live in the registry now (one tiny lock per
+        # instrument — no cross-source "snapshot dance" in get_stats).
+        self._stats_lock = threading.Lock()
 
         # Seeded crash points for the kill -9 harness
         # (tests/crash_harness.py): a positive count SIGKILLs this
@@ -130,6 +181,21 @@ class Node:
         self._commits_delivered = 0
         self._syncs_applied = 0
         self._shutdown_done = False
+
+    # Legacy counter attributes, read by tests and old callers: the
+    # values now come from the registry children.
+
+    @property
+    def sync_requests(self) -> int:
+        return int(self._m_sync_requests.value)
+
+    @property
+    def sync_errors(self) -> int:
+        return int(self._m_sync_errors.value)
+
+    @property
+    def fast_forwards(self) -> int:
+        return int(self._m_fast_forwards.value)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -481,30 +547,43 @@ class Node:
     def _gossip(self, peer_addr: str) -> None:
         if self._shutdown.is_set():
             return
-        try:
-            sync_limit, other_known = self._pull(peer_addr)
-        except TransportError as exc:
-            self.logger.debug("pull from %s failed: %s", peer_addr, exc)
-            self._peer_failed(peer_addr)
-            return
-        except Exception as exc:  # noqa: BLE001
-            self.logger.error("pull from %s failed: %s", peer_addr, exc)
-            self._peer_failed(peer_addr)
-            return
+        with self.trace.span("gossip", cat="gossip",
+                             peer=peer_addr) as rec:
+            try:
+                sync_limit, other_known = self._pull(peer_addr)
+            except TransportError as exc:
+                self.logger.debug(
+                    "pull from %s failed: %s", peer_addr, exc,
+                    extra={"peer": peer_addr,
+                           "span_id": rec.get("span_id")})
+                rec["outcome"] = "pull_failed"
+                self._peer_failed(peer_addr)
+                return
+            except Exception as exc:  # noqa: BLE001
+                self.logger.error(
+                    "pull from %s failed: %s", peer_addr, exc,
+                    extra={"peer": peer_addr,
+                           "span_id": rec.get("span_id")})
+                rec["outcome"] = "pull_failed"
+                self._peer_failed(peer_addr)
+                return
 
-        if sync_limit:
-            # The peer answered (it is healthy) — WE are the ones
-            # lagging behind.
-            self._peer_ok(peer_addr)
-            self.state.set_state(NodeState.CATCHING_UP)
-            return
+            if sync_limit:
+                # The peer answered (it is healthy) — WE are the ones
+                # lagging behind.
+                rec["outcome"] = "sync_limit"
+                self._peer_ok(peer_addr)
+                self.state.set_state(NodeState.CATCHING_UP)
+                return
 
-        try:
-            self._push(peer_addr, other_known)
-        except Exception as exc:  # noqa: BLE001
-            self.logger.debug("push to %s failed: %s", peer_addr, exc)
-            self._peer_failed(peer_addr)
-            return
+            try:
+                self._push(peer_addr, other_known)
+            except Exception as exc:  # noqa: BLE001
+                self.logger.debug("push to %s failed: %s", peer_addr, exc)
+                rec["outcome"] = "push_failed"
+                self._peer_failed(peer_addr)
+                return
+            rec["outcome"] = "ok"
 
         self._peer_ok(peer_addr)
         with self.selector_lock:
@@ -524,6 +603,7 @@ class Node:
             except TransportError:
                 if attempt == attempts - 1:
                     raise
+                self._m_sync_retries.inc()
                 # Jittered exponential backoff between attempts; a
                 # shutdown mid-wait aborts the round immediately.
                 delay = backoff * (2.0 ** attempt)
@@ -531,20 +611,35 @@ class Node:
                 if self._shutdown.wait(delay):
                     raise
 
+    def _rtt_hist(self, peer_addr: str, leg: str):
+        # Cached per (peer, leg): this sits on the per-RPC hot path,
+        # and the registry's get-or-create pays a label-key sort plus
+        # the registry lock on every call.
+        child = self._rtt_hists.get((peer_addr, leg))
+        if child is None:
+            child = self.registry.histogram(
+                "babble_gossip_rtt_seconds",
+                "Gossip RPC round-trip seconds per peer and leg",
+                node=self._node_label, peer=peer_addr, leg=leg)
+            self._rtt_hists[(peer_addr, leg)] = child
+        return child
+
     def _pull_once(self, peer_addr: str):
         if self._shutdown.is_set():
             raise TransportError("node is shutting down")
         with self.core_lock:
             known = self.core.known()
 
-        with self._stats_lock:
-            self.sync_requests += 1
+        self._m_sync_requests.inc()
+        t0 = time.monotonic()
         try:
             resp = self.trans.sync(peer_addr, SyncRequest(self.id, known))
         except Exception:
-            with self._stats_lock:
-                self.sync_errors += 1
+            self._m_sync_errors.inc()
             raise
+        # Per-peer pull RTT: only SUCCESSFUL round trips (a timeout's
+        # wall measures the timeout knob, not the network).
+        self._rtt_hist(peer_addr, "pull").observe(time.monotonic() - t0)
 
         if resp.sync_limit:
             return True, None
@@ -563,14 +658,14 @@ class Node:
             diff = self.core.diff(known)
             wire_events = self.core.to_wire(diff)
 
-        with self._stats_lock:
-            self.sync_requests += 1
+        self._m_sync_requests.inc()
+        t0 = time.monotonic()
         try:
             self.trans.eager_sync(peer_addr, EagerSyncRequest(self.id, wire_events))
         except Exception:
-            with self._stats_lock:
-                self.sync_errors += 1
+            self._m_sync_errors.inc()
             raise
+        self._rtt_hist(peer_addr, "push").observe(time.monotonic() - t0)
 
     def _sync(self, events) -> None:
         """Insert synced events + run consensus (caller holds core_lock)
@@ -606,24 +701,33 @@ class Node:
         with self.selector_lock:
             peer = self.peer_selector.next()
         if peer is not None:
-            try:
-                resp = self.trans.fast_forward(
-                    peer.net_addr, FastForwardRequest(self.id))
-                roots = {pk: Root.from_dict(d)
-                         for pk, d in resp.roots.items()}
-                events = [event_from_json_obj(o) for o in resp.events]
-                with self.core_lock:
-                    self.core.fast_forward(roots, events)
-                with self._stats_lock:
-                    self.fast_forwards += 1
-                self._peer_ok(peer.net_addr)
-                self.logger.info(
-                    "fast-forward from %s: %d frame events",
-                    peer.net_addr, len(events))
-            except Exception as exc:  # noqa: BLE001
-                self._peer_failed(peer.net_addr)
-                self.logger.error(
-                    "fast-forward from %s failed: %s", peer.net_addr, exc)
+            with self.trace.span("fast_forward", cat="gossip",
+                                 peer=peer.net_addr) as rec:
+                try:
+                    resp = self.trans.fast_forward(
+                        peer.net_addr, FastForwardRequest(self.id))
+                    roots = {pk: Root.from_dict(d)
+                             for pk, d in resp.roots.items()}
+                    events = [event_from_json_obj(o) for o in resp.events]
+                    with self.core_lock:
+                        self.core.fast_forward(roots, events)
+                    self._m_fast_forwards.inc()
+                    rec["events"] = len(events)
+                    rec["outcome"] = "ok"
+                    self._peer_ok(peer.net_addr)
+                    self.logger.info(
+                        "fast-forward from %s: %d frame events",
+                        peer.net_addr, len(events),
+                        extra={"peer": peer.net_addr,
+                               "span_id": rec.get("span_id")})
+                except Exception as exc:  # noqa: BLE001
+                    rec["outcome"] = "failed"
+                    self._peer_failed(peer.net_addr)
+                    self.logger.error(
+                        "fast-forward from %s failed: %s",
+                        peer.net_addr, exc,
+                        extra={"peer": peer.net_addr,
+                               "span_id": rec.get("span_id")})
         self.state.set_state(NodeState.BABBLING)
 
     # -- RPC serving -------------------------------------------------------
@@ -716,7 +820,23 @@ class Node:
     # -- app side ----------------------------------------------------------
 
     def _commit(self, block: Block) -> None:
-        self.proxy.commit_block(block)
+        txs = block.transactions or []
+        with self.trace.span("commit", cat="commit",
+                             round=block.round_received, txs=len(txs)):
+            self.proxy.commit_block(block)
+        # Submit->commit latency: observe AFTER app delivery (the
+        # latency a client sees), one sample per transaction this node
+        # stamped at intake. Blocks replayed by bootstrap carry no
+        # stamps and contribute no samples.
+        now = time.monotonic()
+        if txs:
+            with self._tx_stamp_lock:
+                stamps = [self._tx_stamps.pop(tx, None) for tx in txs]
+            for t0 in stamps:
+                if t0 is not None:
+                    self._m_commit_latency.observe(now - t0)
+        self._m_blocks.inc()
+        self._m_txs_committed.inc(len(txs))
         self._commits_delivered += 1
         if self._crash_after_commits and \
                 self._commits_delivered >= self._crash_after_commits:
@@ -729,26 +849,91 @@ class Node:
         # journal tail), never loses, the block.
         self.core.hg.store.set_last_committed_block(block.round_received)
 
+    def _stamp_tx(self, tx: bytes) -> None:
+        """Record the submit->commit intake stamp (first writer wins)."""
+        with self._tx_stamp_lock:
+            if tx in self._tx_stamps:
+                return
+            if len(self._tx_stamps) >= self._tx_stamp_cap:
+                # Evict the oldest stamp (insertion-ordered dict): a tx
+                # that never commits must not pin memory.
+                self._tx_stamps.pop(next(iter(self._tx_stamps)))
+            self._tx_stamps[tx] = time.monotonic()
+
     def _add_transaction(self, tx: bytes) -> None:
+        # Stamp here too: txs submitted straight through the app
+        # proxy's channel (socket clients) never pass submit_tx.
+        self._stamp_tx(tx)
+        self._m_txs_submitted.inc()
         with self.core_lock:
             self.core.add_transactions([tx])
 
     def submit_tx(self, tx: bytes) -> None:
-        """Convenience for in-process callers (tests, demos)."""
+        """Convenience for in-process callers (tests, demos, POST
+        /submit). Stamped at intake so the commit-latency histogram
+        includes the submit-queue wait."""
+        self._stamp_tx(tx)
         self.submit_ch.put(tx)
 
     # -- observability -----------------------------------------------------
 
+    def _refresh_telemetry_gauges(self) -> None:
+        """Point-in-time gauges for /metrics, refreshed at scrape time
+        (the /metrics handler and get_stats call this): breaker state
+        per peer, engine degradation, consensus progress, and the
+        store's durability view — each read from its own source with
+        its own locking, no cross-source lock dance."""
+        reg = self.registry
+        nl = self._node_label
+        g = lambda name, help="", **lb: reg.gauge(name, help, node=nl, **lb)  # noqa: E731
+
+        g("babble_uptime_seconds").set(time.monotonic() - self.start_time)
+        state_codes = {NodeState.BABBLING: 0, NodeState.CATCHING_UP: 1,
+                       NodeState.SHUTDOWN: 2}
+        g("babble_node_state",
+          "0=babbling 1=catching_up 2=shutdown").set(
+            state_codes.get(self.state.get_state(), -1))
+        core = self.core
+        lcr = core.get_last_consensus_round_index()
+        g("babble_last_consensus_round").set(-1 if lcr is None else lcr)
+        g("babble_consensus_events").set(core.get_consensus_events_count())
+        g("babble_consensus_txs").set(
+            core.get_consensus_transactions_count())
+        g("babble_undetermined_events").set(
+            len(core.get_undetermined_events()))
+        g("babble_transaction_pool").set(len(core.transaction_pool))
+        g("babble_engine_backlog").set(core.engine_backlog())
+        engine_codes = {"host": 0, "device": 1, "failed_over": 2}
+        g("babble_engine_state", "0=host 1=device 2=failed_over").set(
+            engine_codes.get(core.engine_state, -1))
+        store = core.hg.store
+        g("babble_last_committed_block").set(store.last_committed_block())
+        dstats = getattr(store, "durability_stats", None)
+        if dstats is not None:
+            d = dstats()
+            g("babble_store_wal_bytes").set(d["wal_bytes"])
+            g("babble_store_fsyncs").set(d["fsync_count"])
+        # Per-peer circuit-breaker view (empty snapshot when health
+        # tracking is disabled — the gauges then simply never appear).
+        state_code = {"closed": 0, "half_open": 1, "open": 2}
+        for addr, h in self.get_peer_stats().items():
+            g("babble_breaker_state", "0=closed 1=half_open 2=open",
+              peer=addr).set(state_code.get(h["state"], -1))
+            g("babble_breaker_trips", "Cumulative breaker trips",
+              peer=addr).set(h["trips"])
+            g("babble_breaker_consecutive_failures",
+              peer=addr).set(h["consecutive_failures"])
+
     def get_stats(self) -> Dict[str, str]:
+        self._refresh_telemetry_gauges()
         elapsed = time.monotonic() - self.start_time
-        # Snapshot the gossip counters under the lock they are
-        # incremented under — unlocked reads could pair a fresh
-        # sync_errors with a stale sync_requests and report a rate
-        # above 1 (or below 0).
-        with self._stats_lock:
-            sync_requests = self.sync_requests
-            sync_errors = self.sync_errors
-            fast_forwards = self.fast_forwards
+        # Read errors BEFORE requests: requests increments strictly
+        # before errors on every path, so this order can only under-
+        # count errors relative to requests and the rate stays in
+        # [0, 1] — no shared lock needed across the two counters.
+        sync_errors = self._m_sync_errors.value
+        sync_requests = self._m_sync_requests.value
+        fast_forwards = self.fast_forwards
         sync_rate = (1.0 - sync_errors / sync_requests
                      if sync_requests else 1.0)
         consensus_events = self.core.get_consensus_events_count()
@@ -814,10 +999,13 @@ class Node:
         }
 
     def sync_rate(self) -> float:
-        with self._stats_lock:
-            if self.sync_requests == 0:
-                return 1.0
-            return 1.0 - self.sync_errors / self.sync_requests
+        # Errors before requests — see get_stats for the ordering
+        # argument that keeps the rate in [0, 1] without a shared lock.
+        errors = self._m_sync_errors.value
+        requests = self._m_sync_requests.value
+        if requests == 0:
+            return 1.0
+        return 1.0 - errors / requests
 
     def _suspended_peer_count(self) -> int:
         with self.selector_lock:

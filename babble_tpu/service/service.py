@@ -8,7 +8,13 @@ GET /debug/phases serves the overlap-aware per-phase timers as
 structured numbers: for each phase the last/total/calls triple from
 Core.phase_ns, plus the engine's pipeline diagnostics (host-blocking
 pull share vs the device compute that overlapped gossip ingest) — the
-attribution view for "what bounds this node's consensus rate"."""
+attribution view for "what bounds this node's consensus rate".
+
+GET /metrics serves the process-global telemetry registry in
+Prometheus text exposition format (counters, breaker-state gauges,
+submit->commit / gossip-RTT / fsync latency histograms), and GET
+/debug/trace serves the node's span ring as Chrome trace-event JSON
+that loads directly in Perfetto — see docs/observability.md."""
 
 from __future__ import annotations
 
@@ -34,33 +40,65 @@ class Service:
         service = self
 
         class Handler(BaseHTTPRequestHandler):
-            def _json(self, code, obj):
-                body = json.dumps(obj).encode()
+            # One serialization + CORS path for every endpoint — the
+            # per-endpoint hand-rolled header blocks kept drifting
+            # (the /Stats handler sent three CORS headers, the rest
+            # one, 404s none and an empty body that scrapers read as
+            # "server up, metric gone").
+            def _send(self, code, body, content_type):
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Access-Control-Allow-Origin", "*")
+                self.send_header(
+                    "Access-Control-Allow-Methods",
+                    "POST, GET, OPTIONS, PUT, DELETE")
+                self.send_header(
+                    "Access-Control-Allow-Headers",
+                    "Accept, Content-Type, Content-Length, "
+                    "Accept-Encoding, X-CSRF-Token, Authorization")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _json(self, code, obj):
+                self._send(code, json.dumps(obj).encode(),
+                           "application/json")
+
+            def _not_found(self):
+                # A JSON body, not an empty 404: scrapers and probes
+                # must fail loudly on a wrong path, not parse "".
+                self._json(404, {"error": "unknown path",
+                                 "path": urlparse(self.path).path})
+
             def do_GET(self):  # noqa: N802 - stdlib API
                 url = urlparse(self.path)
                 if url.path.rstrip("/") in ("/Stats", "/stats", ""):
-                    body = json.dumps(service.node.get_stats()).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Access-Control-Allow-Origin", "*")
-                    self.send_header(
-                        "Access-Control-Allow-Methods", "POST, GET, OPTIONS, PUT, DELETE"
-                    )
-                    self.send_header(
-                        "Access-Control-Allow-Headers",
-                        "Accept, Content-Type, Content-Length, Accept-Encoding, "
-                        "X-CSRF-Token, Authorization",
-                    )
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._json(200, service.node.get_stats())
+                elif url.path.rstrip("/") == "/metrics":
+                    # Prometheus text exposition (docs/observability
+                    # .md): the node's own registry (gossip, consensus,
+                    # breaker, latency histograms) merged with the
+                    # process-global one (store fsyncs, chaos-transport
+                    # faults). Point-in-time gauges (breaker states,
+                    # backlog, WAL size) are refreshed here;
+                    # counters/histograms are live.
+                    from ..telemetry import get_registry, render_merged
+
+                    node = service.node
+                    node._refresh_telemetry_gauges()
+                    body = render_merged(
+                        get_registry(), node.registry).encode()
+                    self._send(
+                        200, body,
+                        "text/plain; version=0.0.4; charset=utf-8")
+                elif url.path.rstrip("/") == "/debug/trace":
+                    # The span ring as Chrome trace-event JSON — loads
+                    # directly in Perfetto (ui.perfetto.dev) for a real
+                    # timeline of how syncs, consensus passes, commits
+                    # and fast-forwards interleaved.
+                    node = service.node
+                    self._json(200, node.trace.to_chrome_trace(
+                        pid=node.id))
                 elif url.path.rstrip("/") == "/debug/phases":
                     core = service.node.core
                     phases = {
@@ -144,8 +182,7 @@ class Service:
                     finally:
                         service._profile_lock.release()
                 else:
-                    self.send_response(404)
-                    self.end_headers()
+                    self._not_found()
 
             def do_POST(self):  # noqa: N802 - stdlib API
                 url = urlparse(self.path)
@@ -185,8 +222,7 @@ class Service:
                     except Exception as exc:  # noqa: BLE001
                         self._json(500, {"error": str(exc)})
                 else:
-                    self.send_response(404)
-                    self.end_headers()
+                    self._not_found()
 
             def do_OPTIONS(self):  # noqa: N802 - CORS preflight
                 self.send_response(200)

@@ -1,0 +1,32 @@
+"""Unified telemetry (docs/observability.md): a process-global metrics
+registry (counters / gauges / log-scale histograms) serving `GET
+/metrics` in Prometheus text format, a bounded span ring exported at
+`/debug/trace` as Perfetto-loadable Chrome trace JSON, a scrape
+parser/checker, and structured JSON logging."""
+
+from .jsonlog import JsonLogFormatter, use_json_logging
+from .registry import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    HistogramSnapshot,
+    Registry,
+    get_registry,
+    render_merged,
+)
+from .trace import SpanRing
+
+__all__ = [
+    "Counter",
+    "DEFAULT_BUCKETS",
+    "Gauge",
+    "Histogram",
+    "HistogramSnapshot",
+    "JsonLogFormatter",
+    "Registry",
+    "SpanRing",
+    "get_registry",
+    "render_merged",
+    "use_json_logging",
+]

@@ -1,0 +1,162 @@
+"""Prometheus text-exposition parser + scrape checker.
+
+The consumer side of registry.render(): tests and bench parse a
+/metrics scrape back into families instead of regex-poking the text,
+and CI can pipe a scrape through the module CLI to fail loudly on a
+malformed exposition or a missing core series:
+
+    curl -s http://127.0.0.1:8000/metrics | \
+        python -m babble_tpu.telemetry.promtext \
+            --require babble_commit_latency_seconds \
+            --require babble_breaker_state
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .registry import HistogramSnapshot
+
+Sample = Tuple[Dict[str, str], float]
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r"\s+(?P<value>[^\s]+)(?:\s+(?P<ts>-?\d+))?$")
+_LABEL_RE = re.compile(
+    r'(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:\\.|[^"\\])*)"')
+
+
+def _unescape(v: str) -> str:
+    return v.replace(r"\"", '"').replace(r"\n", "\n").replace("\\\\", "\\")
+
+
+def parse(text: str) -> Tuple[Dict[str, List[Sample]], Dict[str, str]]:
+    """Parse an exposition into (samples, types).
+
+    samples: sample name -> [(labels, value)] — histogram series appear
+    under their full `_bucket`/`_sum`/`_count` sample names.
+    types: family name -> declared TYPE.
+
+    Raises ValueError on any line that is neither a comment, blank,
+    nor a well-formed sample — a scraper must fail loudly, not skip."""
+    samples: Dict[str, List[Sample]] = {}
+    types: Dict[str, str] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: malformed sample {line!r}")
+        labels: Dict[str, str] = {}
+        raw = m.group("labels")
+        if raw:
+            consumed = 0
+            for lm in _LABEL_RE.finditer(raw):
+                labels[lm.group("k")] = _unescape(lm.group("v"))
+                consumed = lm.end()
+            # Everything past the last match must be separators, else
+            # the label block was malformed (e.g. an unquoted value).
+            if not labels or raw[consumed:].strip(", \t"):
+                raise ValueError(
+                    f"line {lineno}: malformed labels {raw!r}")
+        try:
+            value = float(m.group("value").replace("+Inf", "inf")
+                          .replace("-Inf", "-inf"))
+        except ValueError as exc:
+            raise ValueError(
+                f"line {lineno}: bad value {m.group('value')!r}") from exc
+        samples.setdefault(m.group("name"), []).append((labels, value))
+    return samples, types
+
+
+def histogram_snapshot(
+        samples: Dict[str, List[Sample]], name: str,
+        match: Optional[Dict[str, str]] = None) -> HistogramSnapshot:
+    """Rebuild a merged HistogramSnapshot from parsed `_bucket`/`_sum`/
+    `_count` series whose labels contain `match` — so scrape-side
+    checks can compute p50/p99 with the same bucket math as the
+    in-process registry."""
+    match = match or {}
+
+    def keep(labels: Dict[str, str]) -> bool:
+        return all(labels.get(k) == v for k, v in match.items())
+
+    # Cumulative bucket counts, grouped per child label set.
+    children: Dict[Tuple[Tuple[str, str], ...],
+                   List[Tuple[float, float]]] = {}
+    for labels, value in samples.get(f"{name}_bucket", []):
+        if not keep(labels):
+            continue
+        le = labels["le"]
+        bound = float("inf") if le == "+Inf" else float(le)
+        key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+        children.setdefault(key, []).append((bound, value))
+    if not children:
+        raise ValueError(f"no {name}_bucket series matching {match}")
+
+    total_sum = sum(v for labels, v in samples.get(f"{name}_sum", [])
+                    if keep(labels))
+    snap: Optional[HistogramSnapshot] = None
+    for series in children.values():
+        series.sort()
+        bounds = tuple(b for b, _ in series if b != float("inf"))
+        cum = [c for _, c in series]
+        counts, prev = [], 0.0
+        for c in cum:
+            counts.append(int(c - prev))
+            prev = c
+        child = HistogramSnapshot(bounds, tuple(counts), 0.0, int(cum[-1]))
+        snap = child if snap is None else snap.merge(child)
+    return HistogramSnapshot(snap.buckets, snap.counts, total_sum,
+                             snap.count)
+
+
+def check_series(samples: Dict[str, List[Sample]],
+                 required: Iterable[str]) -> List[str]:
+    """Return the required family names with NO samples in the scrape
+    (histograms count as present when their _count series exists)."""
+    missing = []
+    for name in required:
+        if name not in samples and f"{name}_count" not in samples:
+            missing.append(name)
+    return missing
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m babble_tpu.telemetry.promtext",
+        description="Validate a Prometheus text scrape from stdin.")
+    ap.add_argument("--require", action="append", default=[],
+                    metavar="NAME",
+                    help="fail unless this metric family has samples "
+                         "(repeatable)")
+    args = ap.parse_args(argv)
+    text = sys.stdin.read()
+    try:
+        samples, types = parse(text)
+    except ValueError as exc:
+        print(f"promtext: parse error: {exc}", file=sys.stderr)
+        return 1
+    missing = check_series(samples, args.require)
+    if missing:
+        print(f"promtext: missing required series: {missing}",
+              file=sys.stderr)
+        return 1
+    print(f"promtext: ok ({len(samples)} sample families, "
+          f"{len(types)} typed)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,117 @@
+"""Bounded span ring buffer + Chrome trace-event export.
+
+A SpanRing records the last N spans (monotonic start/end ns, a
+category lane, and small key/value args: peer, batch size, outcome)
+with one lock-protected deque append per span — cheap enough to leave
+on in production. `/debug/trace` serves the ring as Chrome trace-event
+JSON, which loads directly in Perfetto (ui.perfetto.dev) or
+chrome://tracing: each node is a process row, each span category a
+thread lane, so a sync arriving mid device-pass is visibly overlapped
+— the timeline view the aggregate `phase_ns` totals cannot show
+(docs/observability.md)."""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class SpanRing:
+    """Fixed-capacity ring of completed spans. capacity <= 0 disables
+    recording entirely (span() still yields an attrs dict, so call
+    sites never branch)."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = max(0, capacity)
+        self._spans: Optional[deque] = (
+            deque(maxlen=self.capacity) if self.capacity else None)
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "node", **args):
+        """Record one span around the body. Yields the args dict, so
+        the body can attach outcome fields (`rec["outcome"] = ...`)
+        that are only known at the end; the span's id is pre-assigned
+        in `rec["span_id"]` so log lines emitted inside the span can
+        carry it (`extra={"span_id": rec["span_id"]}` — the JSON log
+        formatter lifts it). Exceptions propagate; the span is
+        recorded either way with outcome=error unless the body set
+        its own."""
+        rec = dict(args)
+        if self._spans is None:
+            yield rec
+            return
+        rec["span_id"] = next(self._ids)
+        t0 = time.perf_counter_ns()
+        try:
+            yield rec
+        except BaseException:
+            rec.setdefault("outcome", "error")
+            raise
+        finally:
+            self.record(name, t0, time.perf_counter_ns(), cat=cat, **rec)
+
+    def record(self, name: str, start_ns: int, end_ns: int,
+               cat: str = "node", **args) -> int:
+        """Append one completed span; returns its span id (0 when the
+        ring is disabled) for log correlation. A pre-assigned
+        `span_id` in args (the span() context manager's) is honored."""
+        if self._spans is None:
+            return 0
+        span_id = args.pop("span_id", None) or next(self._ids)
+        entry = {
+            "id": span_id,
+            "name": name,
+            "cat": cat,
+            "t0": start_ns,
+            "t1": end_ns,
+            "args": args,
+        }
+        with self._lock:
+            self._spans.append(entry)
+        return span_id
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans) if self._spans is not None else 0
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return list(self._spans) if self._spans is not None else []
+
+    def to_chrome_trace(self, pid: int = 0,
+                        process_name: str = "babble-node") -> dict:
+        """Chrome trace-event JSON object format: complete ("X")
+        events in microseconds, one tid lane per span category, with
+        process/thread name metadata so Perfetto labels the rows."""
+        spans = self.snapshot()
+        lanes: Dict[str, int] = {}
+        events: List[dict] = [{
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": f"{process_name} {pid}"},
+        }]
+        for sp in spans:
+            lane = lanes.get(sp["cat"])
+            if lane is None:
+                lane = len(lanes) + 1
+                lanes[sp["cat"]] = lane
+                events.append({
+                    "ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": lane, "args": {"name": sp["cat"]},
+                })
+            events.append({
+                "ph": "X",
+                "name": sp["name"],
+                "cat": sp["cat"],
+                "pid": pid,
+                "tid": lane,
+                "ts": sp["t0"] / 1000.0,
+                "dur": (sp["t1"] - sp["t0"]) / 1000.0,
+                "args": dict(sp["args"], span_id=sp["id"]),
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -305,10 +305,56 @@ def host_engine_events_per_sec(n_peers, n_events, seed=7):
     return len(h.consensus_events()) / dt, len(h.consensus_events()), dt
 
 
+def _audit_metrics_scrape(node, phases, file_store=False):
+    """Scrape a live node's /metrics over real HTTP, run it through
+    the exposition parser, and FAIL (raise) when a core series is
+    missing — the CI node-smoke job runs this so a telemetry
+    regression breaks the build, not the next incident. Also loads
+    /debug/trace and checks it is valid Chrome trace JSON."""
+    import urllib.request
+
+    from babble_tpu.service import Service
+    from babble_tpu.telemetry import promtext
+
+    svc = Service("127.0.0.1:0", node)
+    svc.serve_async()
+    try:
+        with urllib.request.urlopen(
+                f"http://{svc.addr}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        samples, _types = promtext.parse(text)  # raises on bad format
+        required = [
+            "babble_commit_latency_seconds",
+            "babble_gossip_rtt_seconds",
+            "babble_breaker_state",
+            "babble_engine_pass_seconds",
+            "babble_sync_requests_total",
+            "babble_phase_seconds",
+        ]
+        if file_store:
+            required.append("babble_store_fsync_seconds")
+        missing = promtext.check_series(samples, required)
+        if missing:
+            raise RuntimeError(
+                f"/metrics scrape is missing core series: {missing}")
+        with urllib.request.urlopen(
+                f"http://{svc.addr}/debug/trace", timeout=10) as r:
+            trace = json.loads(r.read())
+        if not trace.get("traceEvents"):
+            raise RuntimeError("/debug/trace has no traceEvents")
+        phases["metrics_scrape"] = {
+            "families": len(samples),
+            "trace_events": len(trace["traceEvents"]),
+        }
+    finally:
+        svc.close()
+
+
 def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
                                 window_s=30.0, interval=None,
                                 warm_gate_events=1500, windows=1,
-                                store="inmem", store_sync="batch"):
+                                store="inmem", store_sync="batch",
+                                metrics_scrape=False):
     """Throughput of a live localhost testnet: N real nodes (threads,
     inmem transport, signed events, full sync protocol) bombarded with
     transactions; returns (committed consensus events/sec during a
@@ -426,6 +472,11 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
         deadline = time.monotonic() + warm_s
         while time.monotonic() < deadline and committed() < warm_gate_events:
             time.sleep(0.5)
+        # Commit-latency snapshot at window start: the p50/p99 below is
+        # a DELTA over the measurement windows (warmup samples — cold
+        # caches, first compiles — would otherwise poison the tail),
+        # merged across every node's submit->commit histogram.
+        lat0 = [nd._m_commit_latency.snapshot() for nd in nodes]
         # Median over `windows` measurement windows: a single window is
         # at the mercy of transient tunnel stalls (observed: a 62s
         # stall inside an otherwise 5.6s-rep run tanked one window 2.5x
@@ -440,6 +491,10 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
             # c1 <= c0: a lagging node fast-forwarded (store reset,
             # node.py _fast_forward) or the chip stalled — skip the
             # window.
+        lat = None
+        for nd, before in zip(nodes, lat0):
+            delta = nd._m_commit_latency.snapshot() - before
+            lat = delta if lat is None else lat.merge(delta)
         # Per-phase breakdown (harvested before shutdown): node-level
         # phases and, for the device engine, its sub-phases. The
         # engine_* entries are subsets of consensus_dispatch/collect
@@ -487,6 +542,17 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
             # in-mem.
             phases["store_commit_share"] = round(
                 tot["store_commit"] / sum(top.values()), 3)
+        if lat is not None and lat.count > 0:
+            # End-to-end submit->commit latency over the measurement
+            # windows, cross-node (docs/observability.md).
+            phases["commit_latency_p50_ms"] = round(
+                lat.quantile(0.5) * 1000.0, 2)
+            phases["commit_latency_p99_ms"] = round(
+                lat.quantile(0.99) * 1000.0, 2)
+            phases["commit_latency_samples"] = lat.count
+        if metrics_scrape:
+            _audit_metrics_scrape(nodes[0], phases,
+                                  file_store=(store == "file"))
     finally:
         _sys.setswitchinterval(old_switch)
         stop.set()
@@ -521,11 +587,20 @@ def node_smoke():
     try:
         eps, phases = node_testnet_events_per_sec(
             engine="host", n_nodes=3, warm_s=8.0, window_s=12.0,
-            interval=0.0, warm_gate_events=200, windows=1)
+            interval=0.0, warm_gate_events=200, windows=1,
+            metrics_scrape=True)
         payload["node_events_per_s"] = round(eps, 1)
         payload["node_phase_share"] = phases.get("phase_share")
         payload["node_ingest_phase_share"] = phases.get(
             "ingest_phase_share")
+        # End-to-end submit->commit latency over the measurement
+        # window (docs/observability.md) — the headline observability
+        # numbers next to throughput.
+        payload["commit_latency_p50_ms"] = phases.get(
+            "commit_latency_p50_ms")
+        payload["commit_latency_p99_ms"] = phases.get(
+            "commit_latency_p99_ms")
+        payload["metrics_scrape"] = phases.get("metrics_scrape")
     except Exception as exc:  # noqa: BLE001
         payload["error"] = str(exc)
         _emit(payload)
@@ -535,12 +610,18 @@ def node_smoke():
         # store_commit_share is the fraction of node phase wall spent
         # in sqlite COMMITs; the events/s delta against the in-mem leg
         # above is the full durable-path overhead (record in BENCH).
+        # The scrape audit runs here too: the file leg must expose the
+        # fsync-latency histogram on top of the core series.
         feps, fphases = node_testnet_events_per_sec(
             engine="host", n_nodes=3, warm_s=8.0, window_s=12.0,
             interval=0.0, warm_gate_events=200, windows=1,
-            store="file")
+            store="file", metrics_scrape=True)
         payload["node_file_events_per_s"] = round(feps, 1)
         payload["store_commit_share"] = fphases.get("store_commit_share")
+        payload["file_commit_latency_p50_ms"] = fphases.get(
+            "commit_latency_p50_ms")
+        payload["file_commit_latency_p99_ms"] = fphases.get(
+            "commit_latency_p99_ms")
     except Exception as exc:  # noqa: BLE001
         payload["file_store_error"] = str(exc)
     _emit(payload)
@@ -793,6 +874,10 @@ def child():
                 payload["node_phase_share"] = node_ph.get("phase_share")
                 payload["node_ingest_phase_share"] = node_ph.get(
                     "ingest_phase_share")
+                payload["commit_latency_p50_ms"] = node_ph.get(
+                    "commit_latency_p50_ms")
+                payload["commit_latency_p99_ms"] = node_ph.get(
+                    "commit_latency_p99_ms")
                 _emit(payload)
             except Exception as exc:  # noqa: BLE001
                 log(f"  node host stage failed: {exc}")

@@ -254,22 +254,60 @@ def test_chaos_quick_convergence():
     assert total["drop"] > 0 and total["duplicate"] > 0
 
 
+def _scrape_metrics(addr):
+    """GET /metrics and parse it — a malformed exposition fails the
+    soak, exactly like it would fail a real Prometheus scrape."""
+    import urllib.request
+
+    from babble_tpu.telemetry import promtext
+
+    with urllib.request.urlopen(f"http://{addr}/metrics", timeout=10) as r:
+        assert r.status == 200
+        return promtext.parse(r.read().decode())[0]
+
+
 @pytest.mark.slow
 def test_chaos_soak():
     """The acceptance soak (ISSUE 2): 4-node net under >=20% drop,
     50-200ms jittered delay, one asymmetric partition that heals
     mid-run, one node crash + recovery — byte-identical consensus
-    order on all nodes, with a fixed seed."""
+    order on all nodes, with a fixed seed.
+
+    Telemetry audit (ISSUE 5): /metrics is scraped over real HTTP
+    mid-partition and again while node 2 is crashed — the breaker
+    gauges and the submit->commit latency tail must REFLECT the
+    injected faults, not just exist."""
+    from babble_tpu.service import Service
+    from babble_tpu.telemetry import promtext
+
     nodes, faults = make_chaos_nodes(
         4, seed=31337, heartbeat=0.02,
         drop=0.2, delay_min=0.05, delay_max=0.2, duplicate=0.2)
     addr = {i: nodes[i].local_addr for i in range(4)}
+    service = Service("127.0.0.1:0", nodes[0])
+    service.serve_async()
+    breaker_max = 0.0
     try:
         # Phase 1: asymmetric partition 0 -/-> 1 from the start.
         faults[addr[0]].partition(addr[1])
         for nd in nodes:
             nd.run_async(gossip=True)
         bombard_until(nodes, target_round=2, timeout=120.0)
+
+        # Mid-partition scrape: node 0's outbound leg to node 1 has
+        # been failing the whole phase, so its breaker series must
+        # show activity against that peer.
+        samples = _scrape_metrics(service.addr)
+        trips = {lb["peer"]: v for lb, v in
+                 samples.get("babble_breaker_trips", [])}
+        states = [v for _, v in samples.get("babble_breaker_state", [])]
+        assert addr[1] in trips, "no breaker series for the partitioned peer"
+        breaker_max = max([trips[addr[1]]] + states)
+        # Fault injection is visible on the scrape too (process-global
+        # registry: the chaos transport's own counters).
+        fault_kinds = {lb["kind"] for lb, v in
+                       samples["babble_transport_faults_total"] if v > 0}
+        assert "partitioned" in fault_kinds
 
         # Phase 2: heal the partition; crash node 2 (both legs dead).
         faults[addr[0]].heal()
@@ -278,6 +316,23 @@ def test_chaos_soak():
         bombard_until(survivors, target_round=5, timeout=120.0,
                       submit_to=survivors)
 
+        # Mid-crash scrape: with >=20% drop and 50-200ms injected
+        # delay on every RPC, the submit->commit p99 cannot be in the
+        # sub-delay range a healthy localhost net shows.
+        samples = _scrape_metrics(service.addr)
+        lat = promtext.histogram_snapshot(
+            samples, "babble_commit_latency_seconds")
+        assert lat.count > 0, "no commit-latency samples under chaos"
+        p50, p99 = lat.quantile(0.5), lat.quantile(0.99)
+        assert 0 < p50 <= p99
+        assert p99 >= 0.05, f"p99 {p99}s does not reflect injected delay"
+        breaker_max = max(
+            [breaker_max]
+            + [v for _, v in samples.get("babble_breaker_trips", [])]
+            + [v for _, v in samples.get("babble_breaker_state", [])])
+        assert breaker_max > 0, (
+            "breaker gauges never reflected the partition/crash")
+
         # Phase 3: node 2 comes back and catches up; everyone must
         # reach the final target together.
         faults[addr[2]].restore()
@@ -285,6 +340,7 @@ def test_chaos_soak():
     finally:
         for nd in nodes:
             nd.shutdown()
+        service.close()
     check_gossip(nodes)
     injected = {k: sum(f.injected[k] for f in faults.values())
                 for k in next(iter(faults.values())).injected}
