@@ -117,6 +117,7 @@ def cmd_run(args) -> int:
         engine_failover_threshold=(
             0 if args.no_failover else args.engine_failover_threshold),
         trace_ring=args.trace_ring,
+        trace_sample=args.trace_sample,
         logger=logger,
     )
 
@@ -227,6 +228,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "(last N sync/consensus/commit spans as "
                          "Perfetto-loadable Chrome trace JSON; 0 "
                          "disables)")
+    rn.add_argument("--trace_sample", type=float, default=0.0,
+                    help="end-to-end transaction tracing sample rate "
+                         "in [0,1]: sampled txs carry a trace id "
+                         "across gossip hops and drop Chrome flow "
+                         "events (submit -> gossip legs -> consensus "
+                         "pass -> CommitBlock) into /debug/trace; "
+                         "merge nodes with python -m "
+                         "babble_tpu.telemetry.tracemerge. 0 disables "
+                         "(no per-tx overhead); 0.001 is the "
+                         "documented 'on' rate")
     rn.add_argument("--heartbeat", type=int, default=1000,
                     help="heartbeat timer in milliseconds")
     rn.add_argument("--max_pool", type=int, default=2,

@@ -135,6 +135,12 @@ class Event(GoStruct):
         # docs/ingest.md): sound while body/R/S are frozen.
         self._sig_ok: Optional[bool] = None
         self._wire: Optional["WireEvent"] = None
+        # Distributed-tracing annotation (docs/observability.md): the
+        # trace id of a sampled transaction this event carries. NOT
+        # part of the signed body or the Go-JSON encoding — it rides
+        # the wire form as sidecar metadata and never influences
+        # hashes, signatures, or consensus.
+        self.trace_id: int = 0
 
     # -- construction ------------------------------------------------------
 
@@ -282,6 +288,7 @@ class Event(GoStruct):
                 ),
                 r=self.r,
                 s=self.s,
+                trace_id=self.trace_id,
             )
         return w
 
@@ -348,10 +355,16 @@ class WireEvent(GoStruct):
         ("S", "s"),
     )
 
-    def __init__(self, body: WireBody, r: int, s: int):
+    def __init__(self, body: WireBody, r: int, s: int, trace_id: int = 0):
         self.body = body
         self.r = BigInt(r)
         self.s = BigInt(s)
+        # Sidecar tracing metadata (docs/observability.md): rides the
+        # JSON relay as "_TraceID" ONLY when set, so an untraced wire
+        # event serializes byte-identically to the pre-tracing form
+        # (legacy interop pinned by tests/test_tracing.py) and the
+        # Go-JSON marshal (go_fields above) never sees it.
+        self.trace_id = trace_id
         self._dict: Optional[dict] = None
 
     def to_dict(self) -> dict:
@@ -378,6 +391,8 @@ class WireEvent(GoStruct):
             "R": int(self.r),
             "S": int(self.s),
         }
+        if self.trace_id:
+            d["_TraceID"] = self.trace_id
         return d
 
     @classmethod
@@ -398,6 +413,7 @@ class WireEvent(GoStruct):
             ),
             r=obj["R"],
             s=obj["S"],
+            trace_id=obj.get("_TraceID", 0),
         )
 
 

@@ -454,7 +454,9 @@ class Hashgraph:
         body.other_parent_index = wevent.body.other_parent_index
         body.creator_id = wevent.body.creator_id
 
-        return Event(body, r=wevent.r, s=wevent.s)
+        ev = Event(body, r=wevent.r, s=wevent.s)
+        ev.trace_id = wevent.trace_id
+        return ev
 
     def read_wire_batch(self, wire_events: List[WireEvent]) -> List[Event]:
         """Materialize a whole sync batch of wire events at once.
@@ -521,6 +523,9 @@ class Hashgraph:
             body.other_parent_index = wb.other_parent_index
             body.creator_id = wb.creator_id
             ev = Event(body, r=wevent.r, s=wevent.s)
+            # Sidecar tracing annotation survives the hop, so this
+            # node's own diffs relay the id onward (multi-hop flows).
+            ev.trace_id = wevent.trace_id
             local[(wb.creator_id, wb.index)] = ev.hex()
             out.append(ev)
         return out

@@ -8,6 +8,7 @@ node answers via RPC.respond."""
 from __future__ import annotations
 
 import queue
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Protocol
 
@@ -22,15 +23,26 @@ class TransportError(Exception):
 class SyncRequest:
     from_id: int
     known: Dict[int, int]
+    # Shared-epoch clock handshake (telemetry/clock.py): the
+    # requester's epoch-domain send stamp (ns). 0 = no handshake (a
+    # legacy peer); the field rides the RPC dict only when set, so the
+    # pre-handshake wire form is unchanged and Go-style decoders
+    # ignore the extra key either way.
+    t_send: int = 0
 
     def to_dict(self) -> dict:
-        return {"FromID": self.from_id, "Known": {str(k): v for k, v in self.known.items()}}
+        d = {"FromID": self.from_id,
+             "Known": {str(k): v for k, v in self.known.items()}}
+        if self.t_send:
+            d["ClockSend"] = self.t_send
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "SyncRequest":
         return cls(
             from_id=d["FromID"],
             known={int(k): v for k, v in (d.get("Known") or {}).items()},
+            t_send=d.get("ClockSend", 0),
         )
 
 
@@ -40,14 +52,27 @@ class SyncResponse:
     sync_limit: bool = False
     events: List[WireEvent] = field(default_factory=list)
     known: Dict[int, int] = field(default_factory=dict)
+    # Clock handshake echo: the request's ClockSend (t0), the
+    # responder's receive stamp (t1, taken when the RPC object was
+    # constructed — before queue wait) and reply stamp (t2), all
+    # epoch-domain ns on the responder's clock except t_origin. Zero =
+    # the responder does not speak the handshake.
+    t_origin: int = 0
+    t_recv: int = 0
+    t_reply: int = 0
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "FromID": self.from_id,
             "SyncLimit": self.sync_limit,
             "Events": [e.to_dict() for e in self.events],
             "Known": {str(k): v for k, v in self.known.items()},
         }
+        if self.t_recv:
+            d["ClockOrigin"] = self.t_origin
+            d["ClockRecv"] = self.t_recv
+            d["ClockReply"] = self.t_reply
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "SyncResponse":
@@ -56,6 +81,9 @@ class SyncResponse:
             sync_limit=d.get("SyncLimit", False),
             events=[WireEvent.from_json_obj(e) for e in (d.get("Events") or [])],
             known={int(k): v for k, v in (d.get("Known") or {}).items()},
+            t_origin=d.get("ClockOrigin", 0),
+            t_recv=d.get("ClockRecv", 0),
+            t_reply=d.get("ClockReply", 0),
         )
 
 
@@ -141,13 +169,18 @@ class RPCResponse:
 
 
 class RPC:
-    """An inbound request plus its response channel."""
+    """An inbound request plus its response channel. `recv_pc_ns` is
+    the raw perf_counter receive stamp, taken at construction — i.e.
+    before any consumer-queue wait — so the clock handshake's t1 is
+    the closest thing to wire arrival every transport can offer
+    without protocol changes (the node rebases it onto its epoch)."""
 
-    __slots__ = ("command", "resp_chan")
+    __slots__ = ("command", "resp_chan", "recv_pc_ns")
 
     def __init__(self, command, resp_chan: Optional[queue.Queue] = None):
         self.command = command
         self.resp_chan = resp_chan if resp_chan is not None else queue.Queue(1)
+        self.recv_pc_ns = time.perf_counter_ns()
 
     def respond(self, resp, err: Optional[Exception] = None) -> None:
         self.resp_chan.put(RPCResponse(resp, err))

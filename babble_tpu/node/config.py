@@ -110,7 +110,23 @@ class Config:
     # exported as Perfetto-loadable Chrome trace JSON. One deque append
     # per span — cheap enough to leave on; 0 disables recording.
     trace_ring: int = 4096
+    # End-to-end transaction tracing sample rate in [0, 1]: a sampled
+    # transaction gets a trace id at submit intake; the id rides the
+    # wire event across gossip hops and every touchpoint (submit,
+    # gossip send/recv, consensus pass, CommitBlock) drops a Chrome
+    # flow event into the span ring, so a tracemerge'd Perfetto view
+    # shows exactly where that tx's commit latency went. 0 (default)
+    # disables sampling entirely — stamping and flow emission are
+    # no-ops and the wire form is byte-identical to the untraced one.
+    # TRACE_SAMPLE_DEFAULT is the documented rate for "turn it on":
+    # roughly one traced tx per thousand, measured within the 5%
+    # overhead bar (docs/observability.md).
+    trace_sample: float = 0.0
     logger: logging.Logger = field(default_factory=_default_logger)
+
+
+# The documented "on" rate for --trace_sample (see Config.trace_sample).
+TRACE_SAMPLE_DEFAULT = 0.001
 
 
 def test_config(heartbeat: float = 0.005, cache_size: int = 10000) -> Config:

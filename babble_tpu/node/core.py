@@ -116,6 +116,12 @@ class Core:
         self.head = ""
         self.seq = -1
         self.transaction_pool: List[bytes] = []
+        # Trace ids of SAMPLED pool transactions (docs/observability.md
+        # "Transaction tracing"): empty unless the owner stamps one, so
+        # the untraced hot path pays a single falsy check. The id is
+        # copied onto the self-event that wraps the tx and rides its
+        # wire form across gossip hops.
+        self._pool_trace_ids: Dict[bytes, int] = {}
         # phase -> (last ns, total ns, calls); written only under the
         # node's core lock, like every other Core mutation.
         self.phase_ns: Dict[str, List[int]] = {}
@@ -232,6 +238,7 @@ class Core:
             # replay, head/seq must track the RESET store — stale ones
             # would wedge every later self-event and sync.
             self.transaction_pool = []
+            self._pool_trace_ids = {}
             self._recover_head_and_seq()
 
     def sign_and_insert_self_event(self, event: Event) -> None:
@@ -350,12 +357,15 @@ class Core:
         # later has_event hits mask never-persisted events.
         t0 = time.perf_counter_ns()
         other_head = ""
+        traced: List[int] = []
         store = self.hg.store
         store.begin_batch()
         try:
             for k, ev in enumerate(events):
                 if not has_event(ev.hex()):
                     self.insert_event(ev, False)
+                    if ev.trace_id:
+                        traced.append(ev.trace_id)
                 if k == len(events) - 1:
                     # Head selection: the peer's head is the LAST event
                     # of its diff even when that event was skipped as a
@@ -372,10 +382,17 @@ class Core:
                     self.pub_key(),
                     self.seq + 1,
                 )
+                new_head.trace_id = self._pool_trace_id()
                 self.sign_and_insert_self_event(new_head)
                 self.transaction_pool = []
         finally:
             store.commit_batch()
+        # Flow breadcrumbs for sampled transactions that just landed
+        # from a gossip hop — emitted inside the enclosing sync span so
+        # the arrows bind to it (bounded: a flood of traced events must
+        # not turn the ring into flow spam).
+        for tid in traced[:16]:
+            self.trace.flow("t", tid, cat="sync", hop="recv")
 
     def add_self_event(self) -> None:
         """Wrap a non-empty tx pool in a new self-event — reference
@@ -388,6 +405,7 @@ class Core:
             self.pub_key(),
             self.seq + 1,
         )
+        new_head.trace_id = self._pool_trace_id()
         self.sign_and_insert_self_event(new_head)
         self.transaction_pool = []
 
@@ -574,8 +592,27 @@ class Core:
             self.phase_ns["store_commit"] = [
                 store.fsync_last_ns, store.fsync_total_ns, count]
 
-    def add_transactions(self, txs: List[bytes]) -> None:
+    def add_transactions(self, txs: List[bytes],
+                         trace_ids: Optional[Dict[bytes, int]] = None
+                         ) -> None:
         self.transaction_pool.extend(txs)
+        if trace_ids:
+            self._pool_trace_ids.update(trace_ids)
+
+    def _pool_trace_id(self) -> int:
+        """Trace id for the self-event about to wrap the pool: the
+        first sampled tx's id (one id per event — sampling is sparse
+        enough that two sampled txs in one pool are noise). Clears the
+        stamp map alongside the pool flush the callers do."""
+        if not self._pool_trace_ids:
+            return 0
+        ids = self._pool_trace_ids
+        self._pool_trace_ids = {}
+        for tx in self.transaction_pool:
+            tid = ids.get(tx)
+            if tid:
+                return tid
+        return 0
 
     def get_head(self) -> Event:
         return self.hg.store.get_event(self.head)
@@ -609,6 +646,43 @@ class Core:
 
     def get_last_commited_round_events_count(self) -> int:
         return self.hg.last_commited_round_events
+
+    def engine_cost_report(self, wait_s: float = 0.0):
+        """Per-pass compiled-cost attribution for the device engine
+        (docs/observability.md "Device profiling"): arms the engine's
+        one-shot cost capture if no report exists, optionally waits for
+        the next pass to produce it, and mirrors FLOPs/bytes into
+        gauges. None on the host engine."""
+        engine = getattr(self.hg, "engine", None)
+        if engine is None or not hasattr(engine, "request_cost_report"):
+            return None
+        report = engine.cost_report
+        if report is None:
+            engine.request_cost_report()
+            deadline = time.monotonic() + max(0.0, wait_s)
+            while (engine.cost_report is None
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            report = engine.cost_report
+        if report:
+            for kernel, d in report.items():
+                if not isinstance(d, dict):
+                    continue
+                if "flops" in d:
+                    self._registry.gauge(
+                        "babble_engine_pass_flops",
+                        "Compiled FLOPs of one consensus pass kernel",
+                        node=self._node_label, kernel=kernel,
+                    ).set(d["flops"])
+                if "bytes_accessed" in d:
+                    self._registry.gauge(
+                        "babble_engine_pass_bytes",
+                        "Compiled bytes accessed of one consensus pass "
+                        "kernel", node=self._node_label, kernel=kernel,
+                    ).set(d["bytes_accessed"])
+        # {} = capture armed but no pass ran yet (idle node): callers
+        # distinguish "pending" from "not a device engine" (None).
+        return report if report is not None else {}
 
     def engine_backlog(self) -> int:
         """Events appended but not yet folded by a consensus pass —

@@ -24,6 +24,7 @@ reference, whose gossip loop retries dead peers forever."""
 from __future__ import annotations
 
 import contextlib
+import itertools
 import os
 import queue
 import random
@@ -47,7 +48,7 @@ from ..net.transport import (
     TransportError,
 )
 from ..proxy.proxy import AppProxy
-from ..telemetry import Registry, SpanRing
+from ..telemetry import ClusterClock, Registry, SpanRing
 from .config import Config
 from .control_timer import ControlTimer
 from .core import Core
@@ -82,6 +83,22 @@ class Node:
         # here now.
         self.trace = SpanRing(getattr(conf, "trace_ring", 4096))
         self.registry = Registry()
+        # Shared-epoch cluster clock (telemetry/clock.py): rebases this
+        # node's monotonic span stamps onto a cluster-aligned epoch;
+        # fed by the NTP-style handshake piggybacked on gossip pulls.
+        # conf.clock_skew_ns is a test hook simulating a skewed wall
+        # clock (applied to every stamp this node reports, exactly like
+        # a real clock error).
+        self.clock = ClusterClock(
+            skew_ns=getattr(conf, "clock_skew_ns", 0))
+        # Transaction tracing (docs/observability.md): sampled txs get
+        # a trace id at intake; bounded map, same eviction story as the
+        # latency stamps below. Empty unless conf.trace_sample > 0, so
+        # every guard on it is one falsy check.
+        self._trace_sample = float(getattr(conf, "trace_sample", 0.0))
+        self._tx_trace_ids: "Dict[bytes, int]" = {}
+        self._tx_trace_cap = 1024
+        self._trace_seq = itertools.count(1)
         _nl = str(id)
         reg = self.registry
         self._m_sync_requests = reg.counter(
@@ -123,7 +140,7 @@ class Node:
         pmap = store.participants()
         self.core = Core(
             id, key, pmap, store,
-            commit_callback=self.commit_ch.put,
+            commit_callback=self._on_block_decided,
             engine=getattr(conf, "engine", "host"),
             engine_mesh=getattr(conf, "engine_mesh", 0),
             engine_prewarm=getattr(conf, "engine_prewarm", False),
@@ -631,15 +648,23 @@ class Node:
             known = self.core.known()
 
         self._m_sync_requests.inc()
+        # Clock handshake (telemetry/clock.py): every pull doubles as
+        # an NTP sample — t0 at send, the peer echoes its receive and
+        # reply stamps, t3 at response.
+        req = SyncRequest(self.id, known, t_send=self.clock.epoch_ns())
         t0 = time.monotonic()
         try:
-            resp = self.trans.sync(peer_addr, SyncRequest(self.id, known))
+            resp = self.trans.sync(peer_addr, req)
         except Exception:
             self._m_sync_errors.inc()
             raise
+        t3 = self.clock.epoch_ns()
         # Per-peer pull RTT: only SUCCESSFUL round trips (a timeout's
         # wall measures the timeout knob, not the network).
         self._rtt_hist(peer_addr, "pull").observe(time.monotonic() - t0)
+        if resp.t_recv and resp.t_origin == req.t_send:
+            self.clock.observe(
+                peer_addr, req.t_send, resp.t_recv, resp.t_reply, t3)
 
         if resp.sync_limit:
             return True, None
@@ -666,6 +691,7 @@ class Node:
             self._m_sync_errors.inc()
             raise
         self._rtt_hist(peer_addr, "push").observe(time.monotonic() - t0)
+        self._flow_gossip_hop(wire_events, "push", peer_addr)
 
     def _sync(self, events) -> None:
         """Insert synced events + run consensus (caller holds core_lock)
@@ -771,11 +797,32 @@ class Node:
                 with self.core_lock:
                     diff = self.core.diff(cmd.known)
                 resp.events = self.core.to_wire(diff)
+                self._flow_gossip_hop(resp.events, "serve", cmd.from_id)
             except Exception as exc:  # noqa: BLE001
                 resp_err = exc
         with self.core_lock:
             resp.known = self.core.known()
+        if cmd.t_send:
+            # Clock handshake echo: t1 = wire arrival (stamped at RPC
+            # construction, before the consumer-queue wait), t2 = now.
+            resp.t_origin = cmd.t_send
+            resp.t_recv = self.clock.to_epoch(rpc.recv_pc_ns)
+            resp.t_reply = self.clock.epoch_ns()
         rpc.respond(resp, resp_err)
+
+    def _flow_gossip_hop(self, wire_events, hop: str, peer) -> None:
+        """Flow breadcrumbs for traced events leaving this node on a
+        gossip leg (push or pull-serve): which peer, which batch. One
+        cheap attribute check per event when tracing is idle; spans +
+        flows only materialize when a traced event is in the batch."""
+        traced = [w.trace_id for w in wire_events if w.trace_id]
+        if not traced:
+            return
+        with self.trace.span("gossip_" + hop, cat="gossip",
+                             peer=str(peer), batch=len(wire_events)):
+            for tid in traced[:16]:
+                self.trace.flow("t", tid, cat="gossip", hop=hop,
+                                peer=str(peer))
 
     def _process_eager_sync_request(self, rpc: RPC, cmd: EagerSyncRequest) -> None:
         success = True
@@ -819,11 +866,35 @@ class Node:
 
     # -- app side ----------------------------------------------------------
 
+    def _on_block_decided(self, block: Block) -> None:
+        """Core's commit callback: runs on whichever thread ran the
+        consensus pass — i.e. INSIDE the consensus_pass/collect span —
+        before the block is queued for app delivery. That placement is
+        what lets a sampled tx's flow chain point at the exact engine
+        pass that decided it. One falsy check when tracing is idle."""
+        if self._tx_trace_ids:
+            for tx in block.transactions or []:
+                tid = self._tx_trace_ids.get(tx)
+                if tid:
+                    self.trace.flow("t", tid, cat="consensus",
+                                    hop="decided",
+                                    round=block.round_received)
+        self.commit_ch.put(block)
+
     def _commit(self, block: Block) -> None:
         txs = block.transactions or []
         with self.trace.span("commit", cat="commit",
                              round=block.round_received, txs=len(txs)):
             self.proxy.commit_block(block)
+            if txs and self._tx_trace_ids:
+                # Flow finish INSIDE the commit span (the arrow binds
+                # to it): submit -> hops -> decided -> CommitBlock.
+                with self._tx_stamp_lock:
+                    tids = [self._tx_trace_ids.pop(tx, 0) for tx in txs]
+                for tid in tids:
+                    if tid:
+                        self.trace.flow("f", tid, cat="commit",
+                                        round=block.round_received)
         # Submit->commit latency: observe AFTER app delivery (the
         # latency a client sees), one sample per transaction this node
         # stamped at intake. Blocks replayed by bootstrap carry no
@@ -850,7 +921,8 @@ class Node:
         self.core.hg.store.set_last_committed_block(block.round_received)
 
     def _stamp_tx(self, tx: bytes) -> None:
-        """Record the submit->commit intake stamp (first writer wins)."""
+        """Record the submit->commit intake stamp (first writer wins),
+        and roll the tracing dice when sampling is on."""
         with self._tx_stamp_lock:
             if tx in self._tx_stamps:
                 return
@@ -859,14 +931,34 @@ class Node:
                 # that never commits must not pin memory.
                 self._tx_stamps.pop(next(iter(self._tx_stamps)))
             self._tx_stamps[tx] = time.monotonic()
+        if self._trace_sample > 0.0:
+            self._maybe_trace_tx(tx)
+
+    def _maybe_trace_tx(self, tx: bytes) -> None:
+        """Sample this tx for end-to-end tracing: assign a cluster-
+        unique trace id and open the flow chain with a tiny tx_submit
+        span. Off the hot path unless conf.trace_sample > 0."""
+        if random.random() >= self._trace_sample:
+            return
+        tid = ((self.id + 1) << 32) | (next(self._trace_seq) & 0xFFFFFFFF)
+        with self._tx_stamp_lock:
+            if tx in self._tx_trace_ids:
+                return
+            if len(self._tx_trace_ids) >= self._tx_trace_cap:
+                self._tx_trace_ids.pop(next(iter(self._tx_trace_ids)))
+            self._tx_trace_ids[tx] = tid
+        with self.trace.span("tx_submit", cat="tx", trace_id=tid):
+            self.trace.flow("s", tid, cat="tx")
 
     def _add_transaction(self, tx: bytes) -> None:
         # Stamp here too: txs submitted straight through the app
         # proxy's channel (socket clients) never pass submit_tx.
         self._stamp_tx(tx)
         self._m_txs_submitted.inc()
+        tid = self._tx_trace_ids.get(tx, 0) if self._tx_trace_ids else 0
         with self.core_lock:
-            self.core.add_transactions([tx])
+            self.core.add_transactions(
+                [tx], trace_ids={tx: tid} if tid else None)
 
     def submit_tx(self, tx: bytes) -> None:
         """Convenience for in-process callers (tests, demos, POST
@@ -913,6 +1005,18 @@ class Node:
             d = dstats()
             g("babble_store_wal_bytes").set(d["wal_bytes"])
             g("babble_store_fsyncs").set(d["fsync_count"])
+        # Shared-epoch clock view (telemetry/clock.py): per-peer offset
+        # estimates from the gossip handshake and this node's cluster
+        # adjustment. Gauges appear after the first handshake sample.
+        offsets = self.clock.offsets()
+        if offsets:
+            for addr, off in offsets.items():
+                g("babble_clock_offset_ns",
+                  "Estimated peer clock offset (peer minus us, ns)",
+                  peer=addr).set(off)
+            g("babble_clock_adjust_ns",
+              "This node's adjustment onto the cluster epoch (ns)"
+              ).set(self.clock.cluster_adjust_ns())
         # Per-peer circuit-breaker view (empty snapshot when health
         # tracking is disabled — the gauges then simply never appear).
         state_code = {"closed": 0, "half_open": 1, "open": 2}

@@ -775,6 +775,17 @@ class IncrementalEngine:
         # (node/core.go:278-296). Keys: coords, fd, frontier, rounds,
         # fame_rr.
         self.phase_ns: dict = {}
+        # Compiled-cost attribution (docs/observability.md "Device
+        # profiling"): request_cost_report() arms a one-shot capture;
+        # the next fused-epilogue dispatch AOT-lowers the kernel with
+        # the pass's exact shapes and stores cost_analysis() FLOPs /
+        # bytes here (served by /debug/profile?cost=1 and exported as
+        # gauges). Off unless requested — lower+compile is a cache hit
+        # in steady state but still not free.
+        self.cost_report: Optional[dict] = None
+        self._cost_requested = False
+        # Bytes of the last commit-delta pull (the c_pull transfer).
+        self.c_pull_bytes = 0
         # Redo dispatches over the engine's lifetime (window/cadence
         # tuning diagnostic; deliberately NOT in phase_ns, whose values
         # are nanoseconds).
@@ -1419,7 +1430,7 @@ class IncrementalEngine:
         pp.tw_i = min(pp.tw, rcap)
         pp.t_start = min(pp.t0, rcap - pp.tw_i)
         _t_stage = _time.perf_counter_ns()
-        pp.packed_dev, pp.rounds_out, pp.rr_out = _consensus_fused(
+        fused_args = (
             self._chain_la, self._chain_rb, pp.chain_len_d, pp.la,
             self._ranks, pp.rb,
             self._chain_d, jnp.asarray(wt_tab), jnp.asarray(fr_tab),
@@ -1430,12 +1441,56 @@ class IncrementalEngine:
             jnp.asarray(fam_rel), jnp.asarray(in_list_rel),
             self._chain_th, self._chain_tl, jnp.int32(pp.rx0),
             jnp.int32(self._prev_first_undec), pp.und_up, pp.n_und,
-            jnp.int32(pp.t_start),
-            n=n, sm=sm, rcap=rcap, bp=pp.bp, rw=pp.rw, iw=pp.iw,
-            cb=pp.cb, tw=pp.tw_i)
+            jnp.int32(pp.t_start))
+        fused_kw = dict(n=n, sm=sm, rcap=rcap, bp=pp.bp, rw=pp.rw,
+                        iw=pp.iw, cb=pp.cb, tw=pp.tw_i)
+        pp.packed_dev, pp.rounds_out, pp.rr_out = _consensus_fused(
+            *fused_args, **fused_kw)
         self.phase_ns["c_dispatch"] = (
             self.phase_ns.get("c_dispatch", 0)
             + _time.perf_counter_ns() - _t_stage)
+        if self._cost_requested:
+            # One-shot: an overflow redo of the same pass re-arms only
+            # if the operator asks again.
+            self._cost_requested = False
+            self.cost_report = self._analyze_cost(fused_args, fused_kw)
+
+    def request_cost_report(self) -> None:
+        """Arm a one-shot compiled-cost capture: the next fused-
+        epilogue dispatch records cost_analysis() FLOPs/bytes for its
+        exact shapes into `self.cost_report`."""
+        self.cost_report = None
+        self._cost_requested = True
+
+    def _analyze_cost(self, args, kw) -> dict:
+        """AOT-lower the fused consensus kernel with the pass's exact
+        inputs and pull the compiler's cost model. The kernel has no
+        donated args, so lowering after the real dispatch is safe; the
+        compile itself is a warm-cache hit for the shapes that just
+        ran. Never raises — this is operator tooling riding the
+        staging worker."""
+        try:
+            compiled = _consensus_fused.lower(*args, **kw).compile()
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            out = {
+                "flops": float(ca.get("flops", 0.0) or 0.0),
+                "bytes_accessed": float(
+                    ca.get("bytes accessed", 0.0) or 0.0),
+            }
+            try:
+                mem = compiled.memory_analysis()
+                out["output_bytes"] = float(
+                    getattr(mem, "output_size_in_bytes", 0) or 0)
+                out["temp_bytes"] = float(
+                    getattr(mem, "temp_size_in_bytes", 0) or 0)
+            except Exception:  # noqa: BLE001 - backend-optional API
+                pass
+            return {"consensus_fused": out,
+                    "shapes": {k: int(v) for k, v in kw.items()}}
+        except Exception as exc:  # noqa: BLE001 - report, don't wedge
+            return {"error": str(exc)}
 
     def _collect_pass(self, pp: PendingPass, unlocked) -> RunDelta:
         n = self.n
@@ -1470,10 +1525,31 @@ class IncrementalEngine:
             cd0 = self.phase_ns.get("c_dispatch", 0)
             cp0 = self.phase_ns.get("c_pull", 0)
             while True:
+                # c_pull sub-phases (the bounding sustained phase —
+                # BENCH_r05 put it at 0.44 share): `wait` is device
+                # compute still finishing, `xfer` is the D2H copy of
+                # the packed buffer. The split says whether to attack
+                # the kernel (wait-bound) or the pull payload/transport
+                # (xfer-bound); c_pull stays their sum for every
+                # existing consumer.
                 _t_pull = _t()
+                try:
+                    pp.packed_dev.block_until_ready()
+                except AttributeError:
+                    pass  # non-array stand-ins in tests
+                _t_ready = _t()
                 packed = np.asarray(pp.packed_dev)
+                _t_done = _t()
+                self.phase_ns["c_pull_wait"] = (
+                    self.phase_ns.get("c_pull_wait", 0)
+                    + _t_ready - _t_pull)
+                self.phase_ns["c_pull_xfer"] = (
+                    self.phase_ns.get("c_pull_xfer", 0)
+                    + _t_done - _t_ready)
                 self.phase_ns["c_pull"] = (
-                    self.phase_ns.get("c_pull", 0) + _t() - _t_pull)
+                    self.phase_ns.get("c_pull", 0) + _t_done - _t_pull)
+                self.c_pull_bytes = int(
+                    getattr(pp.packed_dev, "nbytes", 0))
                 t_end = int(packed[0])
                 newly_count = int(packed[1])
                 if t_end == pp.rcap:

@@ -96,9 +96,32 @@ class Service:
                     # directly in Perfetto (ui.perfetto.dev) for a real
                     # timeline of how syncs, consensus passes, commits
                     # and fast-forwards interleaved.
+                    #
+                    # ?epoch=cluster rebases the timestamps onto the
+                    # shared cluster epoch (telemetry/clock.py), so N
+                    # nodes' dumps land on ONE timeline; the raw dump
+                    # embeds the clock block instead, and tracemerge
+                    # applies it. ?since=<cursor> returns only entries
+                    # completed after the cursor (the dump's
+                    # babble.next_since), so a long-poll scraper stops
+                    # re-downloading the full 4096-span ring per
+                    # request.
                     node = service.node
+                    q = parse_qs(url.query)
+                    try:
+                        since = int(q.get("since", ["0"])[0])
+                    except ValueError:
+                        self._json(400, {"error": "bad since cursor"})
+                        return
+                    epoch = q.get("epoch", ["mono"])[0]
+                    rebase = None
+                    meta = {"node": node.id, "epoch": epoch,
+                            "clock": node.clock.describe()}
+                    if epoch == "cluster":
+                        rebase = node.clock.cluster_epoch_ns
                     self._json(200, node.trace.to_chrome_trace(
-                        pid=node.id))
+                        pid=node.id, rebase=rebase, since_seq=since,
+                        meta=meta))
                 elif url.path.rstrip("/") == "/debug/phases":
                     core = service.node.core
                     phases = {
@@ -130,6 +153,10 @@ class Service:
                             "last_pass_phase_ns": dict(engine.phase_ns),
                             "windows": getattr(engine, "_dbg_windows",
                                                None),
+                            "c_pull_bytes": getattr(
+                                engine, "c_pull_bytes", 0),
+                            "cost_report": getattr(
+                                engine, "cost_report", None),
                         }
                     self._json(200, out)
                 elif url.path.rstrip("/") == "/debug/peers":
@@ -149,12 +176,32 @@ class Service:
                     # production (docs/usage.md). Each capture reuses
                     # ONE per-service directory (previous trace is
                     # replaced), so repeated calls cannot fill /tmp.
+                    #
+                    # ?cost=1 skips the profiler and returns per-pass
+                    # compiled-cost attribution instead: the device
+                    # engine AOT-lowers its fused consensus kernel at
+                    # the next pass and reports cost_analysis() FLOPs/
+                    # bytes (also exported as babble_engine_pass_flops/
+                    # _bytes gauges). 202 while the capture is pending
+                    # on an idle node — poll again.
                     try:
-                        secs = float(
-                            parse_qs(url.query).get("seconds", ["5"])[0])
+                        q = parse_qs(url.query)
+                        secs = float(q.get("seconds", ["5"])[0])
                         secs = min(max(secs, 0.1), 30.0)
                     except ValueError:
                         self._json(400, {"error": "bad seconds"})
+                        return
+                    if q.get("cost", ["0"])[0] not in ("0", ""):
+                        report = service.node.core.engine_cost_report(
+                            wait_s=secs)
+                        if report is None:
+                            self._json(400, {
+                                "error": "cost attribution needs the "
+                                         "device engine (--engine tpu)"})
+                        elif not report:
+                            self._json(202, {"pending": True})
+                        else:
+                            self._json(200, {"cost": report})
                         return
                     if not service._profile_lock.acquire(blocking=False):
                         self._json(409, {"error": "profile in progress"})

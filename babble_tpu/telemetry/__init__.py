@@ -4,6 +4,7 @@ registry (counters / gauges / log-scale histograms) serving `GET
 `/debug/trace` as Perfetto-loadable Chrome trace JSON, a scrape
 parser/checker, and structured JSON logging."""
 
+from .clock import ClusterClock
 from .jsonlog import JsonLogFormatter, use_json_logging
 from .registry import (
     DEFAULT_BUCKETS,
@@ -18,6 +19,7 @@ from .registry import (
 from .trace import SpanRing
 
 __all__ = [
+    "ClusterClock",
     "Counter",
     "DEFAULT_BUCKETS",
     "Gauge",

@@ -8,7 +8,20 @@ JSON, which loads directly in Perfetto (ui.perfetto.dev) or
 chrome://tracing: each node is a process row, each span category a
 thread lane, so a sync arriving mid device-pass is visibly overlapped
 — the timeline view the aggregate `phase_ns` totals cannot show
-(docs/observability.md)."""
+(docs/observability.md).
+
+Beyond duration spans the ring also records **flow events** — the
+sampled-transaction breadcrumbs (`ph` "s"/"t"/"f" in the Chrome
+format) that link a tx's submit span to its gossip hops on other
+nodes and finally its CommitBlock. Flow events are matched by `id`
+across processes, so once N nodes' dumps are merged onto one epoch
+(`telemetry.tracemerge`), Perfetto draws one arrow chain per sampled
+transaction across the node rows.
+
+Entries carry a monotonically increasing completion sequence (`seq`),
+the cursor behind `/debug/trace?since=` — scrapers re-fetch only what
+completed since their last poll instead of re-downloading the whole
+ring."""
 
 from __future__ import annotations
 
@@ -17,7 +30,7 @@ import itertools
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 
 class SpanRing:
@@ -31,6 +44,13 @@ class SpanRing:
             deque(maxlen=self.capacity) if self.capacity else None)
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
+        # Completion-order cursor (distinct from span ids, which are
+        # assigned at span START: a long span started early can finish
+        # after later-started ones, so an id-based cursor would skip
+        # it; seq is assigned at record time and strictly orders the
+        # ring).
+        self._seq = itertools.count(1)
+        self._last_seq = 0
 
     @contextlib.contextmanager
     def span(self, name: str, cat: str = "node", **args):
@@ -73,45 +93,116 @@ class SpanRing:
             "args": args,
         }
         with self._lock:
+            entry["seq"] = self._last_seq = next(self._seq)
             self._spans.append(entry)
         return span_id
+
+    def flow(self, phase: str, flow_id: int, cat: str = "tx",
+             name: str = "tx", **args) -> None:
+        """Record one flow-event breadcrumb: phase "s" (start at the
+        sampled tx's submit), "t" (step: a gossip hop, an engine
+        pass), "f" (finish at CommitBlock). Emit from INSIDE the span
+        the breadcrumb belongs to, so its timestamp falls within that
+        slice and the renderer binds the arrow to it. Matched across
+        node pids by `flow_id` after a tracemerge. No-op when the
+        ring is disabled."""
+        if self._spans is None:
+            return
+        entry = {
+            "flow": phase,
+            "fid": flow_id,
+            "name": name,
+            "cat": cat,
+            "t0": time.perf_counter_ns(),
+            "args": args,
+        }
+        with self._lock:
+            entry["seq"] = self._last_seq = next(self._seq)
+            self._spans.append(entry)
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._spans) if self._spans is not None else 0
 
-    def snapshot(self) -> List[dict]:
+    def snapshot(self, since_seq: int = 0) -> List[dict]:
+        """Entries with seq > since_seq, oldest first (all of them at
+        the default cursor 0)."""
         with self._lock:
-            return list(self._spans) if self._spans is not None else []
+            if self._spans is None:
+                return []
+            if since_seq <= 0:
+                return list(self._spans)
+            return [sp for sp in self._spans if sp["seq"] > since_seq]
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._last_seq
 
     def to_chrome_trace(self, pid: int = 0,
-                        process_name: str = "babble-node") -> dict:
+                        process_name: str = "babble-node",
+                        rebase: Optional[Callable[[int], int]] = None,
+                        since_seq: int = 0,
+                        meta: Optional[Dict] = None) -> dict:
         """Chrome trace-event JSON object format: complete ("X")
         events in microseconds, one tid lane per span category, with
-        process/thread name metadata so Perfetto labels the rows."""
-        spans = self.snapshot()
+        process/thread name metadata so Perfetto labels the rows.
+
+        `rebase` maps raw perf_counter ns onto an epoch (the node's
+        ClusterClock for `?epoch=cluster`); default is the raw
+        monotonic domain. Flow entries render as ph "s"/"t"/"f" events
+        on their category's lane. Extra context for tooling (the clock
+        block tracemerge reads, the `next_since` cursor) rides in a
+        top-level "babble" object — renderers ignore unknown keys."""
+        spans = self.snapshot(since_seq)
+        ts = (lambda t: rebase(t) / 1000.0) if rebase is not None \
+            else (lambda t: t / 1000.0)
         lanes: Dict[str, int] = {}
         events: List[dict] = [{
             "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
             "args": {"name": f"{process_name} {pid}"},
         }]
-        for sp in spans:
-            lane = lanes.get(sp["cat"])
+
+        def lane_of(cat: str) -> int:
+            lane = lanes.get(cat)
             if lane is None:
                 lane = len(lanes) + 1
-                lanes[sp["cat"]] = lane
+                lanes[cat] = lane
                 events.append({
                     "ph": "M", "name": "thread_name", "pid": pid,
-                    "tid": lane, "args": {"name": sp["cat"]},
+                    "tid": lane, "args": {"name": cat},
                 })
+            return lane
+
+        last = since_seq
+        for sp in spans:
+            last = max(last, sp["seq"])
+            lane = lane_of(sp["cat"])
+            if "flow" in sp:
+                events.append({
+                    "ph": sp["flow"],
+                    "id": sp["fid"],
+                    "name": sp["name"],
+                    "cat": "tx",
+                    "pid": pid,
+                    "tid": lane,
+                    "ts": ts(sp["t0"]),
+                    "args": dict(sp["args"]),
+                })
+                continue
             events.append({
                 "ph": "X",
                 "name": sp["name"],
                 "cat": sp["cat"],
                 "pid": pid,
                 "tid": lane,
-                "ts": sp["t0"] / 1000.0,
+                "ts": ts(sp["t0"]),
                 "dur": (sp["t1"] - sp["t0"]) / 1000.0,
                 "args": dict(sp["args"], span_id=sp["id"]),
             })
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        out = {"traceEvents": events, "displayTimeUnit": "ms"}
+        babble = {"pid": pid, "next_since": last}
+        if meta:
+            babble.update(meta)
+        out["babble"] = babble
+        return out

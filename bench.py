@@ -354,7 +354,7 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
                                 window_s=30.0, interval=None,
                                 warm_gate_events=1500, windows=1,
                                 store="inmem", store_sync="batch",
-                                metrics_scrape=False):
+                                metrics_scrape=False, trace_sample=0.0):
     """Throughput of a live localhost testnet: N real nodes (threads,
     inmem transport, signed events, full sync protocol) bombarded with
     transactions; returns (committed consensus events/sec during a
@@ -422,6 +422,10 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
             # _consensus_loop).
             interval = 0.25 if engine == "tpu" else 0.0
         conf.consensus_interval = interval
+        # End-to-end tx tracing sample rate (docs/observability.md) —
+        # 0 keeps the stamping/flow paths as no-ops; the trace-overhead
+        # A/B drives this.
+        conf.trace_sample = trace_sample
         if store == "file":
             # Durable-path A/B (docs/robustness.md "Crash recovery"):
             # same testnet over WAL-backed FileStores, so the
@@ -523,8 +527,12 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
             phases["ingest_phase_share"] = {
                 ph: round(v / tot["sync"], 3)
                 for ph, v in sorted(ingest.items())}
+        # c_pull_wait/c_pull_xfer are sub-spans of c_pull (the wait/
+        # transfer split) — they ride along as their own ratio and stay
+        # out of the share denominator, which would double-count them.
         eng_t = {ph[len("engine_"):]: v for ph, v in tot.items()
-                 if ph.startswith("engine_") and ph != "engine_overlap"}
+                 if ph.startswith("engine_") and ph != "engine_overlap"
+                 and not ph.startswith("engine_c_pull_")}
         if eng_t:
             es = sum(eng_t.values())
             phases["engine_phase_share"] = {
@@ -532,6 +540,12 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
             phases["engine_pull_share"] = round(
                 (eng_t.get("c_pull", 0) + eng_t.get("coords", 0)
                  + eng_t.get("fd_fold", 0)) / es, 3)
+            if tot.get("engine_c_pull"):
+                phases["engine_c_pull_split"] = {
+                    "wait": round(tot.get("engine_c_pull_wait", 0)
+                                  / tot["engine_c_pull"], 3),
+                    "xfer": round(tot.get("engine_c_pull_xfer", 0)
+                                  / tot["engine_c_pull"], 3)}
         if "engine_overlap" in tot:
             phases["engine_overlap_s"] = round(
                 tot["engine_overlap"] / 1e9, 2)
@@ -575,15 +589,29 @@ def node_smoke():
     """Host-ingest microbench for CI: a 3-node in-mem host-engine
     gossip testnet (fixed seeds, no TPU, no JAX import) measured for
     ~20s, emitting one JSON line with `node_events_per_s` so host-path
-    regressions are visible per-PR in the job log. Exit code is 0
-    whenever a measurement was made — the number is recorded, not
-    gated (CI machines vary too much for a hard threshold)."""
+    regressions are visible per-PR in the job log. The raw exit code
+    is 0 whenever a measurement was made; the hard gate is
+    bench_compare.py, which diffs this payload against the committed
+    ledger (BENCH_SMOKE.json / BENCH_r*.json) with the
+    `host_events_per_s` machine-speed calibration below normalizing
+    out runner differences."""
     payload = {
         "metric": "node_events_per_s_smoke",
         "unit": "events/s",
         "nodes": 3,
         "engine": "host",
     }
+    try:
+        # Machine-speed calibration: the SAME pinned single-thread
+        # host-engine run (n=64, e=5000, seed 7) the full bench
+        # records as host_events_per_s — the shared yardstick
+        # bench_compare.py uses to normalize throughput/latency
+        # across machines before gating.
+        calib_eps, _, _ = host_engine_events_per_sec(64, 5000)
+        payload["host_events_per_s"] = round(calib_eps, 1)
+        payload["host_events"] = 5000
+    except Exception as exc:  # noqa: BLE001
+        payload["calibration_error"] = str(exc)
     try:
         eps, phases = node_testnet_events_per_sec(
             engine="host", n_nodes=3, warm_s=8.0, window_s=12.0,
@@ -625,6 +653,59 @@ def node_smoke():
     except Exception as exc:  # noqa: BLE001
         payload["file_store_error"] = str(exc)
     _emit(payload)
+    return 0
+
+
+def trace_overhead(reps=4, bar=0.05):
+    """Interleaved A/B of the end-to-end tracing path (same protocol
+    PR 5 used for the telemetry registry): `reps` back-to-back pairs
+    of the 3-node host smoke, one leg with trace_sample=0 (stamping
+    and flow emission must compile down to a falsy check) and one with
+    tracing ON at a rate high enough to actually exercise the flow
+    paths every window (0.05 — 50x the documented production default
+    of 0.001, so the measurement bounds the real overhead from above).
+    Interleaving absorbs machine drift; the medians must agree within
+    `bar` (5%) or the exit code fails the CI job."""
+    on_rate = 0.05
+    off_rates, on_rates = [], []
+    payload = {
+        "metric": "trace_overhead_ab",
+        "nodes": 3,
+        "engine": "host",
+        "trace_sample_on": on_rate,
+        "reps": reps,
+    }
+    try:
+        for rep in range(reps):
+            for label, rate, acc in (("off", 0.0, off_rates),
+                                     ("on", on_rate, on_rates)):
+                eps, _ = node_testnet_events_per_sec(
+                    engine="host", n_nodes=3, warm_s=6.0, window_s=8.0,
+                    interval=0.0, warm_gate_events=150, windows=1,
+                    trace_sample=rate)
+                acc.append(eps)
+                log(f"  rep {rep} {label}: {eps:,.1f} ev/s")
+    except Exception as exc:  # noqa: BLE001
+        payload["error"] = str(exc)
+        _emit(payload)
+        return 1
+    off_rates.sort()
+    on_rates.sort()
+    med = lambda xs: (xs[len(xs) // 2] if len(xs) % 2  # noqa: E731
+                      else (xs[len(xs) // 2 - 1] + xs[len(xs) // 2]) / 2)
+    off_med, on_med = med(off_rates), med(on_rates)
+    overhead = 1.0 - on_med / off_med if off_med > 0 else 0.0
+    payload["off_events_per_s"] = [round(x, 1) for x in off_rates]
+    payload["on_events_per_s"] = [round(x, 1) for x in on_rates]
+    payload["off_median"] = round(off_med, 1)
+    payload["on_median"] = round(on_med, 1)
+    payload["overhead_pct"] = round(overhead * 100.0, 2)
+    payload["bar_pct"] = bar * 100.0
+    payload["within_bar"] = overhead <= bar
+    _emit(payload)
+    if overhead > bar:
+        log(f"trace overhead {overhead:.1%} exceeds the {bar:.0%} bar")
+        return 1
     return 0
 
 
@@ -803,10 +884,15 @@ def child():
         # fd_fold) is the share the tentpole targets: with the delta
         # pull overlapped it should be a small minority of pass wall.
         if phase_tot:
-            tot_ns = sum(phase_tot.values())
+            # c_pull_wait/xfer are a SPLIT of c_pull, not siblings —
+            # keep them out of the share denominator.
+            _sub = ("c_pull_wait", "c_pull_xfer")
+            top_t = {ph: ns for ph, ns in phase_tot.items()
+                     if ph not in _sub}
+            tot_ns = sum(top_t.values())
             shares = {ph: round(ns / tot_ns, 3)
-                      for ph, ns in sorted(phase_tot.items())}
-            bounding = max(phase_tot, key=phase_tot.get)
+                      for ph, ns in sorted(top_t.items())}
+            bounding = max(top_t, key=top_t.get)
             pull_share = (shares.get("c_pull", 0) + shares.get("coords", 0)
                           + shares.get("fd_fold", 0))
             log(f"  phase split: " + ", ".join(
@@ -818,6 +904,15 @@ def child():
             payload["sustained_bounding_phase"] = bounding
             payload["sustained_pull_share"] = round(pull_share, 3)
             payload["sustained_overlap_s"] = round(overlap_ns / 1e9, 2)
+            if phase_tot.get("c_pull"):
+                # Wait (device still computing) vs xfer (D2H copy) —
+                # the attribution that says whether c_pull needs a
+                # faster kernel or a smaller pull.
+                payload["sustained_c_pull_split"] = {
+                    "wait": round(phase_tot.get("c_pull_wait", 0)
+                                  / phase_tot["c_pull"], 3),
+                    "xfer": round(phase_tot.get("c_pull_xfer", 0)
+                                  / phase_tot["c_pull"], 3)}
 
         # Device-time attribution in a SEPARATE short pass (synced
         # per-phase timers serialize every stage, so they must not run
@@ -843,10 +938,12 @@ def child():
             k = hi
         os.environ.pop("BABBLE_ENGINE_TIMERS", None)
         if phase_sync:
-            tot_ns = sum(phase_sync.values())
+            top_s = {ph: ns for ph, ns in phase_sync.items()
+                     if ph not in ("c_pull_wait", "c_pull_xfer")}
+            tot_ns = sum(top_s.values())
             payload["sustained_phase_share_synced"] = {
                 ph: round(ns / tot_ns, 3)
-                for ph, ns in sorted(phase_sync.items())}
+                for ph, ns in sorted(top_s.items())}
         _emit(payload)
 
     on_cpu = jax.default_backend() == "cpu"
@@ -1090,5 +1187,7 @@ if __name__ == "__main__":
         child()
     elif "--node-smoke" in sys.argv:
         sys.exit(node_smoke())
+    elif "--trace-overhead" in sys.argv:
+        sys.exit(trace_overhead())
     else:
         main()

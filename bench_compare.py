@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""Bench regression ledger: diff a fresh bench run against the
+committed BENCH_r*.json trajectory and HARD-FAIL on headline
+regressions — the perf trajectory machine-gated instead of eyeballed.
+
+    # CI gate: run the node smoke and gate it against the ledger
+    python bench.py --node-smoke > fresh.json
+    python bench_compare.py --against BENCH_r05.json \
+        --fresh fresh.json --tolerance 0.10
+
+    # full-bench gate on the TPU box
+    python bench.py > fresh.json
+    python bench_compare.py --against BENCH_r05.json --fresh fresh.json
+
+Three ideas make the gate honest across machines and bench shapes:
+
+1. **Same-shape gating.** A 3-node CI smoke is not a 4-node TPU-box
+   run; comparing their absolute ev/s gates nothing but the runner
+   lottery. Payloads carry a `metric` field naming their shape; a
+   fresh run is gated against the ledger entry OF THE SAME SHAPE —
+   the full trajectory baseline passed via --against when shapes
+   match, else the committed smoke ledger (BENCH_SMOKE.json, refreshed
+   whenever the smoke's expected numbers legitimately move). Baselines
+   of other shapes still print in the delta table, unGated, for the
+   trajectory view.
+
+2. **Machine-speed normalization.** Both the smoke and the full bench
+   record `host_events_per_s` — the SAME pinned single-thread
+   host-engine consensus run (n=64, e=5000, seed 7). The ratio of the
+   fresh yardstick to the baseline yardstick is the machine-speed
+   factor; throughput expectations scale by it and latency
+   expectations by its inverse, so a slower runner does not read as a
+   regression and a faster one does not mask a real one. The
+   yardstick itself is exempt from the gate (it IS the ruler).
+
+3. **Direction-aware tolerance.** Throughput fails when fresh <
+   expected * (1 - tol); latency fails when fresh > expected *
+   (1 + tol). Improvements never fail. BENCH_COMPARE_TOLERANCE
+   overrides --tolerance for known-noisy runners.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# Headline metrics: key -> kind. Throughput is higher-better and
+# normalizes by the machine factor; latency is lower-better and
+# normalizes by its inverse. latency-info rows print but never gate:
+# measured across repeated smoke runs, p50 swings ~25% with where the
+# measurement window lands in the gossip cadence while p99 (pinned by
+# the heartbeat/commit cadence) is stable within ~1% — p99 is the SLO
+# number, p50 is context.
+HEADLINES: Dict[str, str] = {
+    "value": "throughput",
+    "smoke_events_per_s": "throughput",
+    "sustained_events_per_s": "throughput",
+    "sustained_steady_events_per_s": "throughput",
+    "node_events_per_s": "throughput",
+    "node_file_events_per_s": "throughput",
+    "node_tpu_events_per_s": "throughput",
+    "node16_events_per_s": "throughput",
+    "northstar_events_per_s": "throughput",
+    "northstar_incremental_steady_events_per_s": "throughput",
+    "host_events_per_s": "throughput",
+    "commit_latency_p50_ms": "latency-info",
+    "commit_latency_p99_ms": "latency",
+    "file_commit_latency_p50_ms": "latency-info",
+    "file_commit_latency_p99_ms": "latency",
+}
+
+YARDSTICK = "host_events_per_s"
+
+
+def load_payload(path: str) -> dict:
+    """A bench payload: either the raw JSON line bench.py emits or a
+    committed BENCH_r*.json wrapper whose `parsed` field holds it."""
+    with open(path) as f:
+        obj = json.load(f)
+    if isinstance(obj, dict) and "parsed" in obj and isinstance(
+            obj["parsed"], dict):
+        return obj["parsed"]
+    return obj
+
+
+def machine_scale(fresh: dict, baseline: dict) -> Optional[float]:
+    f, b = fresh.get(YARDSTICK), baseline.get(YARDSTICK)
+    if not f or not b:
+        return None
+    return float(f) / float(b)
+
+
+def compare(fresh: dict, baseline: dict, tolerance: float,
+            normalize: bool = True, gate: bool = True) -> List[dict]:
+    """Per-metric delta rows; rows gain status REGRESSION only when
+    `gate` is set (same-shape baselines)."""
+    scale = machine_scale(fresh, baseline) if normalize else None
+    rows: List[dict] = []
+    for key, kind in HEADLINES.items():
+        b, f = baseline.get(key), fresh.get(key)
+        row = {"key": key, "kind": kind, "baseline": b, "fresh": f,
+               "expected": None, "delta_pct": None, "status": "-"}
+        rows.append(row)
+        if b is None or f is None or not isinstance(b, (int, float)) \
+                or not isinstance(f, (int, float)) or b <= 0:
+            continue
+        if kind == "throughput":
+            expected = b * scale if scale else b
+            delta = f / expected - 1.0
+            bad = delta < -tolerance
+        else:
+            expected = b / scale if scale else b
+            delta = f / expected - 1.0
+            bad = delta > tolerance
+        row["expected"] = round(expected, 2)
+        row["delta_pct"] = round(delta * 100.0, 1)
+        if scale and key == YARDSTICK:
+            row["status"] = "yardstick"
+        elif not gate or kind == "latency-info":
+            row["status"] = "info"
+        elif bad:
+            row["status"] = "REGRESSION"
+        else:
+            row["status"] = "ok" if abs(delta) <= tolerance else "improved"
+    return rows
+
+
+def print_table(rows: List[dict], title: str) -> None:
+    print(f"\n== {title} ==")
+    hdr = f"{'metric':<44} {'baseline':>12} {'expected':>12} " \
+          f"{'fresh':>12} {'delta%':>8}  status"
+    print(hdr)
+    print("-" * len(hdr))
+    for r in rows:
+        if r["baseline"] is None and r["fresh"] is None:
+            continue
+        fmt = lambda v: "-" if v is None else f"{v:,.1f}"  # noqa: E731
+        print(f"{r['key']:<44} {fmt(r['baseline']):>12} "
+              f"{fmt(r['expected']):>12} {fmt(r['fresh']):>12} "
+              f"{fmt(r['delta_pct']):>8}  {r['status']}")
+
+
+def print_trajectory(pattern: str, fresh: dict) -> None:
+    paths = sorted(glob.glob(pattern))
+    if not paths:
+        return
+    ledger: List[Tuple[str, dict]] = []
+    for p in paths:
+        try:
+            ledger.append((os.path.basename(p), load_payload(p)))
+        except Exception:  # noqa: BLE001 - a bad ledger file is skipped
+            continue
+    ledger.append(("fresh", fresh))
+    print("\n== trajectory ==")
+    names = [n for n, _ in ledger]
+    print(f"{'metric':<44} " + " ".join(f"{n:>14}" for n in names))
+    for key in HEADLINES:
+        vals = [pl.get(key) for _, pl in ledger]
+        if all(v is None for v in vals):
+            continue
+        cells = " ".join(
+            f"{v:>14,.1f}" if isinstance(v, (int, float)) else f"{'-':>14}"
+            for v in vals)
+        print(f"{key:<44} {cells}")
+
+
+def run_node_smoke() -> dict:
+    """Invoke the smoke in-process-adjacent: a subprocess so JAX env
+    quirks stay contained; the last stdout JSON line is the payload."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "bench.py"), "--node-smoke"],
+        capture_output=True, text=True)
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    if not lines:
+        raise RuntimeError(
+            f"node-smoke produced no payload (rc={out.returncode}): "
+            f"{out.stderr[-500:]}")
+    return json.loads(lines[-1])
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_compare.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--against", required=True,
+                    help="committed baseline (BENCH_r*.json)")
+    ap.add_argument("--fresh", default=None,
+                    help="fresh bench payload JSON ('-' = stdin); "
+                         "default: run bench.py --node-smoke")
+    ap.add_argument("--tolerance", type=float,
+                    default=float(os.environ.get(
+                        "BENCH_COMPARE_TOLERANCE", "0.10")),
+                    help="allowed regression fraction (default 0.10; "
+                         "BENCH_COMPARE_TOLERANCE overrides)")
+    ap.add_argument("--smoke-baseline", default=None,
+                    help="same-shape baseline for smoke payloads "
+                         "(default: BENCH_SMOKE.json beside --against)")
+    ap.add_argument("--trajectory", default=None,
+                    help="glob of ledger files for the trajectory "
+                         "table (default: BENCH_r0*.json beside "
+                         "--against)")
+    ap.add_argument("--no-normalize", action="store_true",
+                    help="disable host-yardstick machine-speed "
+                         "normalization")
+    args = ap.parse_args(argv)
+
+    baseline = load_payload(args.against)
+    if args.fresh == "-":
+        fresh = json.loads(sys.stdin.read())
+    elif args.fresh:
+        fresh = load_payload(args.fresh)
+    else:
+        fresh = run_node_smoke()
+    normalize = not args.no_normalize
+
+    base_dir = os.path.dirname(os.path.abspath(args.against))
+    print_trajectory(
+        args.trajectory or os.path.join(base_dir, "BENCH_r0*.json"),
+        fresh)
+
+    same_shape = fresh.get("metric") == baseline.get("metric")
+    rows = compare(fresh, baseline, args.tolerance, normalize=normalize,
+                   gate=same_shape)
+    scale = machine_scale(fresh, baseline) if normalize else None
+    if same_shape:
+        mode = "GATED"
+    else:
+        mode = ("info only — shape {!r} vs {!r}".format(
+            fresh.get("metric"), baseline.get("metric")))
+    if scale:
+        mode += f", machine scale {scale:.3f}"
+    print_table(rows, f"vs {os.path.basename(args.against)} ({mode})")
+    gated_rows = list(rows) if same_shape else []
+
+    if not same_shape:
+        smoke_path = args.smoke_baseline or os.path.join(
+            base_dir, "BENCH_SMOKE.json")
+        if os.path.exists(smoke_path):
+            smoke_base = load_payload(smoke_path)
+            if fresh.get("metric") == smoke_base.get("metric"):
+                srows = compare(fresh, smoke_base, args.tolerance,
+                                normalize=normalize, gate=True)
+                sscale = machine_scale(fresh, smoke_base) \
+                    if normalize else None
+                print_table(
+                    srows,
+                    f"vs {os.path.basename(smoke_path)} (GATED"
+                    f"{f', machine scale {sscale:.3f}' if sscale else ''})")
+                gated_rows = srows
+            else:
+                print(f"\nnote: {smoke_path} shape "
+                      f"{smoke_base.get('metric')!r} does not match the "
+                      f"fresh payload either — nothing gated")
+        else:
+            print(f"\nnote: no same-shape baseline ({smoke_path} "
+                  "missing) — nothing gated")
+
+    regressions = [r for r in gated_rows if r["status"] == "REGRESSION"]
+    if regressions:
+        print(f"\nFAIL: {len(regressions)} headline regression(s) over "
+              f"the {args.tolerance:.0%} tolerance:")
+        for r in regressions:
+            print(f"  {r['key']}: expected ~{r['expected']}, got "
+                  f"{r['fresh']} ({r['delta_pct']:+.1f}%)")
+        return 1
+    gated_n = sum(1 for r in gated_rows
+                  if r["status"] in ("ok", "improved"))
+    print(f"\nOK: {gated_n} headline metric(s) gated, none regressed "
+          f"beyond {args.tolerance:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

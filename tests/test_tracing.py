@@ -1,0 +1,473 @@
+"""Cluster-wide perf attribution (docs/observability.md): the
+shared-epoch clock handshake, end-to-end transaction tracing (trace
+ids on wire events + Chrome flow events), the tracemerge tool, the
+/debug/trace since/epoch modes, and the bench_compare regression
+gate's comparison semantics."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from babble_tpu.gojson import Timestamp
+from babble_tpu.hashgraph import InmemStore
+from babble_tpu.hashgraph.event import Event, WireBody, WireEvent
+from babble_tpu.net import FaultyTransport, InmemTransport
+from babble_tpu.net.inmem_transport import connect_all
+from babble_tpu.node import Node
+from babble_tpu.node.config import test_config as fast_config
+from babble_tpu.proxy import InmemAppProxy
+from babble_tpu.service import Service
+from babble_tpu.telemetry import ClusterClock, SpanRing, tracemerge
+
+from test_node import check_gossip, make_keyed_peers
+
+CACHE = 10000
+
+
+def make_traced_nodes(n, heartbeat=0.01, trace_sample=0.0,
+                      skews_ns=None, faults=None, seed=11):
+    """An n-node inmem net with per-node trace sampling, injected
+    clock skew, and (optionally) a chaos transport fabric."""
+    inner = [InmemTransport(f"addr{i}", timeout=2.0) for i in range(n)]
+    connect_all(inner)
+    if faults:
+        wrapped = {t.local_addr(): FaultyTransport(t, seed=seed, **faults)
+                   for t in inner}
+    else:
+        wrapped = {t.local_addr(): t for t in inner}
+    entries = make_keyed_peers(n, addr_fn=lambda i: f"addr{i}")
+    peers = [p for _, p in entries]
+    participants = {p.pub_key_hex: i for i, p in enumerate(peers)}
+    nodes = []
+    for i, (key, peer) in enumerate(entries):
+        conf = fast_config(heartbeat=heartbeat)
+        conf.trace_sample = trace_sample
+        if skews_ns:
+            conf.clock_skew_ns = skews_ns[i]
+        store = InmemStore(participants, CACHE)
+        node = Node(conf, i, key, peers, store,
+                    wrapped[peer.net_addr], InmemAppProxy())
+        node.init()
+        nodes.append(node)
+    return nodes
+
+
+def bombard(nodes, seconds, until=None, prefix="traced"):
+    deadline = time.monotonic() + seconds
+    i = 0
+    while time.monotonic() < deadline:
+        nodes[i % len(nodes)].submit_tx(f"{prefix} tx {i}".encode())
+        i += 1
+        if until is not None and until():
+            return True
+        time.sleep(0.02)
+    return until() if until is not None else True
+
+
+# ------------------------------------------------------ cluster clock
+
+
+def test_cluster_clock_ntp_math():
+    clock = ClusterClock()
+    # Peer clock runs 1s ahead; symmetric 10ms legs.
+    t0 = 1_000_000_000
+    one_way = 10_000_000
+    peer_ahead = 1_000_000_000
+    t1 = t0 + one_way + peer_ahead
+    t2 = t1 + 2_000_000  # 2ms processing
+    t3 = t0 + 2 * one_way + 2_000_000
+    clock.observe("p", t0, t1, t2, t3)
+    assert clock.offset_ns("p") == pytest.approx(peer_ahead, abs=1000)
+    # min-RTT filter: a later, slower, heavily-asymmetric sample must
+    # NOT displace the tight one.
+    clock.observe("p", t0, t1 + 500_000_000, t2 + 500_000_000,
+                  t3 + 900_000_000)
+    assert clock.offset_ns("p") == pytest.approx(peer_ahead, abs=1000)
+    # Negative-rtt garbage is dropped.
+    clock.observe("q", 100, 50, 60, 90)
+    assert clock.offset_ns("q") is None
+    # Cluster adjustment: mean of peer offsets with self at 0.
+    assert clock.cluster_adjust_ns() == pytest.approx(
+        peer_ahead / 2, rel=0.01)
+    d = clock.describe()
+    assert set(d) == {"wall_offset_ns", "cluster_adjust_ns",
+                      "peer_offsets_ns"}
+
+
+def test_clock_skew_recovered_under_jittered_delay():
+    """The acceptance check for the offset handshake: two nodes whose
+    clocks disagree by an injected 250ms, gossiping over a chaos
+    transport with 0-50ms jittered delay, converge to an offset
+    estimate within tolerance of the injected skew (the min-RTT filter
+    eats the jitter)."""
+    skew = 250_000_000  # node 1 runs 250ms ahead
+    nodes = make_traced_nodes(
+        2, skews_ns=[0, skew],
+        faults=dict(delay_min=0.0, delay_max=0.05))
+    try:
+        for nd in nodes:
+            nd.run_async(gossip=True)
+        addr0, addr1 = nodes[0].local_addr, nodes[1].local_addr
+
+        def converged():
+            return (nodes[0].clock.offset_ns(addr1) is not None
+                    and nodes[1].clock.offset_ns(addr0) is not None)
+
+        assert bombard(nodes, 20.0, until=converged), \
+            "no handshake samples"
+        # Let the min-RTT filter see a few more samples.
+        bombard(nodes, 2.0)
+        tol = 25_000_000  # 25ms on 0-50ms injected jitter
+        assert nodes[0].clock.offset_ns(addr1) == pytest.approx(
+            skew, abs=tol)
+        assert nodes[1].clock.offset_ns(addr0) == pytest.approx(
+            -skew, abs=tol)
+        # The two nodes' cluster adjustments cancel the skew: their
+        # adjusted epochs agree within tolerance.
+        e0 = nodes[0].clock.cluster_epoch_ns(0)
+        e1 = nodes[1].clock.cluster_epoch_ns(0)
+        assert abs(e0 - e1) < tol
+    finally:
+        for nd in nodes:
+            nd.shutdown()
+
+
+# ------------------------------------------------- trace-id wire form
+
+
+def _wire_event(trace_id=0):
+    body = WireBody(
+        transactions=[b"tx"], self_parent_index=3,
+        other_parent_creator_id=1, other_parent_index=2, creator_id=0,
+        timestamp=Timestamp(1_700_000_000_000_000_000), index=4)
+    return WireEvent(body, r=7, s=9, trace_id=trace_id)
+
+
+def _relay_json(d):
+    """JSON-relay a wire dict exactly as the TCP transport does
+    (bytes -> std base64 strings)."""
+    import base64
+
+    return json.dumps(
+        d, default=lambda b: base64.b64encode(bytes(b)).decode())
+
+
+def test_untraced_wire_form_is_byte_identical():
+    """Legacy-wire interop: a wire event with NO trace id must
+    serialize exactly as the pre-tracing form — no extra key in the
+    relay dict, no change to the Go-JSON encoding."""
+    w = _wire_event(trace_id=0)
+    d = w.to_dict()
+    assert set(d) == {"Body", "R", "S"}
+    assert "_TraceID" not in _relay_json(d)
+    # The Go-JSON marshal never includes the trace id, traced or not:
+    # consensus identity is untouched.
+    assert _wire_event(5).marshal_value() == w.marshal_value()
+
+
+def test_trace_id_wire_round_trip_and_gojson_compat():
+    w = _wire_event(trace_id=42)
+    d = w.to_dict()
+    assert d["_TraceID"] == 42
+    back = WireEvent.from_json_obj(json.loads(_relay_json(d)))
+    assert back.trace_id == 42
+    assert back.body.index == 4 and int(back.r) == 7
+    # Legacy dict (no _TraceID) parses with trace_id 0.
+    legacy = {k: v for k, v in d.items() if k != "_TraceID"}
+    assert WireEvent.from_json_obj(legacy).trace_id == 0
+
+    # Event-level: the trace id rides to_wire() but never the event's
+    # own hash/signature material.
+    ev = Event.new([b"payload"], ["", ""], b"\x01" * 32, 0,
+                   timestamp=Timestamp(1_700_000_000_000_000_000))
+    h0 = ev.hex()
+    ev.trace_id = 99
+    ev.invalidate()
+    assert ev.hex() == h0
+    assert ev.to_wire().trace_id == 99
+
+
+def test_sampling_off_is_noop():
+    """trace_sample=0 (the default): no tx is ever stamped, no flow
+    entries hit the ring, and the wire events a node serves carry no
+    trace ids."""
+    nodes = make_traced_nodes(2, trace_sample=0.0)
+    try:
+        for nd in nodes:
+            nd.run_async(gossip=True)
+        bombard(nodes, 1.5)
+        time.sleep(0.5)
+        for nd in nodes:
+            assert nd._tx_trace_ids == {}
+            assert all("flow" not in sp for sp in nd.trace.snapshot())
+        with nodes[0].core_lock:
+            diff = nodes[0].core.diff({pid: -1 for pid in
+                                       nodes[0].core.known()})
+            wire = nodes[0].core.to_wire(diff)
+        assert wire and all(w.trace_id == 0 for w in wire)
+        assert all("_TraceID" not in w.to_dict() for w in wire)
+    finally:
+        for nd in nodes:
+            nd.shutdown()
+
+
+# --------------------------------------------- flow events + the ring
+
+
+def test_span_ring_flows_and_since_cursor():
+    ring = SpanRing(64)
+    with ring.span("tx_submit", cat="tx"):
+        ring.flow("s", 7, cat="tx")
+    cursor = ring.last_seq
+    with ring.span("commit", cat="commit"):
+        ring.flow("f", 7, cat="commit")
+    # Cursor: only entries completed after `cursor`.
+    newer = ring.snapshot(since_seq=cursor)
+    assert len(newer) == 2 and any(sp.get("flow") == "f" for sp in newer)
+    doc = ring.to_chrome_trace(pid=3, since_seq=cursor)
+    phs = [e["ph"] for e in doc["traceEvents"] if e["ph"] != "M"]
+    assert phs.count("X") == 1 and phs.count("f") == 1
+    assert doc["babble"]["next_since"] == ring.last_seq
+    # Full dump: the flow chain s..f with one shared id.
+    full = ring.to_chrome_trace(pid=3)
+    flows = [e for e in full["traceEvents"] if e["ph"] in ("s", "t", "f")]
+    assert {e["id"] for e in flows} == {7}
+    assert [e["ph"] for e in flows] == ["s", "f"]
+    # Rebase hook shifts ts.
+    shifted = ring.to_chrome_trace(pid=3, rebase=lambda t: t + 10**15)
+    raw = ring.to_chrome_trace(pid=3)
+    x_s = [e for e in shifted["traceEvents"] if e["ph"] == "X"][0]
+    x_r = [e for e in raw["traceEvents"] if e["ph"] == "X"][0]
+    assert x_s["ts"] - x_r["ts"] == pytest.approx(10**15 / 1000.0)
+
+
+def test_tracemerge_merges_and_validates():
+    """Two rings -> two pids -> one timeline: s/f flow pairs resolve
+    across pids, pid collisions are remapped, and per-dump clock
+    blocks rebase raw monotonic dumps onto one epoch."""
+    a, b = SpanRing(16), SpanRing(16)
+    with a.span("tx_submit", cat="tx"):
+        a.flow("s", 1234, cat="tx")
+    with b.span("sync", cat="sync", batch=3):
+        b.flow("t", 1234, cat="sync", hop="recv")
+    with a.span("commit", cat="commit"):
+        a.flow("f", 1234, cat="commit")
+    d0 = a.to_chrome_trace(pid=0, meta={
+        "epoch": "mono",
+        "clock": {"wall_offset_ns": 5_000_000, "cluster_adjust_ns": 0}})
+    d1 = b.to_chrome_trace(pid=1, meta={
+        "epoch": "mono",
+        "clock": {"wall_offset_ns": 0, "cluster_adjust_ns": 1_000_000}})
+    merged = tracemerge.merge([d0, d1])
+    assert tracemerge.validate(merged, require_cross_pid_flow=True) == []
+    # Clock rebase applied: pid 0 events shifted by 5ms, pid 1 by 1ms.
+    x0 = [e for e in merged["traceEvents"]
+          if e["ph"] == "X" and e["pid"] == 0][0]
+    raw0 = [e for e in d0["traceEvents"] if e["ph"] == "X"][0]
+    assert x0["ts"] - raw0["ts"] == pytest.approx(5000.0)
+    # pid collision: merging the same dump twice remaps the second.
+    twice = tracemerge.merge([d0, json.loads(json.dumps(d0))])
+    assert len({e["pid"] for e in twice["traceEvents"]}) == 2
+    # Validator catches broken chains.
+    bad = {"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+         "args": {"name": "x"}},
+        {"ph": "f", "id": 9, "pid": 0, "tid": 1, "ts": 1.0},
+    ]}
+    assert any("flow 9" in p for p in tracemerge.validate(bad))
+
+
+# ----------------------------------------------- live endpoint modes
+
+
+def test_debug_trace_since_and_epoch_modes():
+    nodes = make_traced_nodes(2, trace_sample=1.0)
+    service = Service("127.0.0.1:0", nodes[0])
+    service.serve_async()
+    try:
+        for nd in nodes:
+            nd.run_async(gossip=True)
+        bombard(nodes, 2.0)
+
+        def get(url):
+            with urllib.request.urlopen(url, timeout=5) as r:
+                return json.loads(r.read())
+
+        base = f"http://{service.addr}/debug/trace"
+        doc = get(base)
+        assert doc["babble"]["epoch"] == "mono"
+        assert "clock" in doc["babble"]
+        cursor = doc["babble"]["next_since"]
+        assert cursor > 0
+        n_x = sum(1 for e in doc["traceEvents"] if e["ph"] == "X")
+        assert n_x > 0
+        # Incremental fetch: everything already seen is excluded.
+        doc2 = get(f"{base}?since={cursor}")
+        seen = {e["args"].get("span_id") for e in doc["traceEvents"]
+                if e["ph"] == "X"}
+        again = {e["args"].get("span_id") for e in doc2["traceEvents"]
+                 if e["ph"] == "X"}
+        assert not (seen & again)
+        # Cluster-epoch rebase: timestamps land on wall-clock scale
+        # (raw perf_counter is process uptime — orders of magnitude
+        # smaller than Unix-epoch microseconds).
+        doc3 = get(f"{base}?epoch=cluster")
+        xs = [e["ts"] for e in doc3["traceEvents"] if e["ph"] == "X"]
+        assert xs and min(xs) > 1e15
+        assert doc3["babble"]["epoch"] == "cluster"
+    finally:
+        for nd in nodes:
+            nd.shutdown()
+        service.close()
+
+
+def test_three_node_smoke_traced_tx_spans_two_pids(tmp_path):
+    """THE acceptance smoke: a 3-node host-gossip run with sampling on
+    produces, via tracemerge over the nodes' /debug/trace dumps, ONE
+    Perfetto-loadable timeline in which a sampled transaction's flow
+    events span at least two node pids from submit ("s") to
+    CommitBlock ("f")."""
+    nodes = make_traced_nodes(3, trace_sample=1.0)
+    services = [Service("127.0.0.1:0", nd) for nd in nodes]
+    for svc in services:
+        svc.serve_async()
+    try:
+        for nd in nodes:
+            nd.run_async(gossip=True)
+
+        def merged_doc():
+            dumps = [tracemerge.load_dump(
+                f"http://{svc.addr}/debug/trace") for svc in services]
+            return tracemerge.merge(dumps)
+
+        def has_cross_pid_flow():
+            doc = merged_doc()
+            return tracemerge.validate(
+                doc, require_cross_pid_flow=True) == []
+
+        committed = lambda: min(  # noqa: E731
+            len(nd.core.get_consensus_events()) for nd in nodes)
+        ok = bombard(
+            nodes, 60.0,
+            until=lambda: committed() > 30 and has_cross_pid_flow())
+        assert ok, "no complete cross-pid flow chain emerged"
+
+        # The CLI does the same end to end: dump files, merge, check.
+        paths = []
+        for i, svc in enumerate(services):
+            doc = tracemerge.load_dump(
+                f"http://{svc.addr}/debug/trace")
+            p = tmp_path / f"node{i}.json"
+            p.write_text(json.dumps(doc))
+            paths.append(str(p))
+        out = tmp_path / "merged.json"
+        rc = tracemerge.main(
+            ["--check", "--require-cross-pid-flow", "-o", str(out)]
+            + paths)
+        assert rc == 0
+        merged = json.loads(out.read_text())
+        pids = {e["pid"] for e in merged["traceEvents"]}
+        assert len(pids) == 3
+        # One fully-linked chain: submit somewhere, finish somewhere,
+        # >= 2 pids involved.
+        chains = {}
+        for e in merged["traceEvents"]:
+            if e.get("ph") in ("s", "t", "f"):
+                chains.setdefault(e["id"], []).append(
+                    (e["ph"], e["pid"]))
+        complete = [c for c in chains.values()
+                    if {p for p, _ in c} >= {"s", "f"}
+                    and len({pid for _, pid in c}) >= 2]
+        assert complete, f"chains: {list(chains.values())[:5]}"
+        # The clock gauges surfaced through /metrics.
+        with urllib.request.urlopen(
+                f"http://{services[0].addr}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert "babble_clock_offset_ns" in text
+        check_gossip(nodes)
+    finally:
+        for nd in nodes:
+            nd.shutdown()
+        for svc in services:
+            svc.close()
+
+
+# ------------------------------------------------ bench_compare gate
+
+
+def _load_bench_compare():
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench_compare.py")
+    spec = importlib.util.spec_from_file_location("bench_compare", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_compare_semantics():
+    bc = _load_bench_compare()
+    baseline = {"metric": "node_events_per_s_smoke",
+                "host_events_per_s": 800.0,
+                "node_events_per_s": 200.0,
+                "commit_latency_p50_ms": 300.0,
+                "commit_latency_p99_ms": 500.0}
+    # Same machine speed, clean run: ok.
+    fresh = dict(baseline)
+    rows = bc.compare(fresh, baseline, 0.10)
+    by = {r["key"]: r for r in rows}
+    assert by["node_events_per_s"]["status"] == "ok"
+    assert by["host_events_per_s"]["status"] == "yardstick"
+    assert by["commit_latency_p50_ms"]["status"] == "info"  # never gated
+    # Half-speed machine, proportional numbers: normalization keeps it
+    # green (200 -> 100 ev/s is the machine, not a regression).
+    slow = {"metric": baseline["metric"], "host_events_per_s": 400.0,
+            "node_events_per_s": 100.0, "commit_latency_p99_ms": 1000.0}
+    by = {r["key"]: r for r in bc.compare(slow, baseline, 0.10)}
+    assert by["node_events_per_s"]["status"] == "ok"
+    assert by["commit_latency_p99_ms"]["status"] == "ok"
+    # Real regression on the same machine: caught, direction-aware.
+    bad = dict(baseline, node_events_per_s=150.0,
+               commit_latency_p99_ms=600.0)
+    by = {r["key"]: r for r in bc.compare(bad, baseline, 0.10)}
+    assert by["node_events_per_s"]["status"] == "REGRESSION"
+    assert by["commit_latency_p99_ms"]["status"] == "REGRESSION"
+    # Improvements never fail.
+    good = dict(baseline, node_events_per_s=400.0,
+                commit_latency_p99_ms=250.0)
+    by = {r["key"]: r for r in bc.compare(good, baseline, 0.10)}
+    assert by["node_events_per_s"]["status"] == "improved"
+    assert by["commit_latency_p99_ms"]["status"] == "improved"
+    # gate=False (shape mismatch): informational only.
+    by = {r["key"]: r for r in bc.compare(bad, baseline, 0.10,
+                                          gate=False)}
+    assert by["node_events_per_s"]["status"] == "info"
+
+
+def test_bench_compare_cli_gate(tmp_path):
+    bc = _load_bench_compare()
+    base = {"metric": "node_events_per_s_smoke",
+            "host_events_per_s": 800.0, "node_events_per_s": 200.0}
+    (tmp_path / "BENCH_SMOKE.json").write_text(json.dumps(
+        {"parsed": base}))
+    full = {"metric": "consensus_events_per_s_n64", "value": 60000.0,
+            "host_events_per_s": 800.0}
+    against = tmp_path / "BENCH_r05.json"
+    against.write_text(json.dumps({"parsed": full}))
+    ok = tmp_path / "fresh.json"
+    ok.write_text(json.dumps(dict(base, node_events_per_s=195.0)))
+    assert bc.main(["--against", str(against), "--fresh", str(ok)]) == 0
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(dict(base, node_events_per_s=100.0)))
+    assert bc.main(["--against", str(against), "--fresh", str(bad)]) == 1
+    # Full-bench shape gates straight against --against.
+    fullbad = tmp_path / "fullbad.json"
+    fullbad.write_text(json.dumps(dict(full, value=40000.0)))
+    assert bc.main(
+        ["--against", str(against), "--fresh", str(fullbad)]) == 1
