@@ -78,7 +78,7 @@ def cmd_run(args) -> int:
         # the config as early as possible.)
         from .devices import ensure_compile_cache
 
-        ensure_compile_cache()
+        ensure_compile_cache(args.compile_cache_dir or None)
 
     datadir = args.datadir
     key = PemKey(datadir).read_key()
@@ -118,6 +118,9 @@ def cmd_run(args) -> int:
             0 if args.no_failover else args.engine_failover_threshold),
         trace_ring=args.trace_ring,
         trace_sample=args.trace_sample,
+        wire_format=args.wire_format,
+        max_msg_bytes=args.max_msg_bytes << 20,
+        compile_cache_dir=args.compile_cache_dir,
         logger=logger,
     )
 
@@ -141,7 +144,8 @@ def cmd_run(args) -> int:
         store = InmemStore(pmap, conf.cache_size)
 
     trans = TCPTransport(
-        args.node_addr, max_pool=args.max_pool, timeout=conf.tcp_timeout
+        args.node_addr, max_pool=args.max_pool, timeout=conf.tcp_timeout,
+        wire_format=conf.wire_format, max_msg_bytes=conf.max_msg_bytes,
     )
 
     if args.journal:
@@ -298,6 +302,23 @@ def build_parser() -> argparse.ArgumentParser:
     rn.add_argument("--no_prewarm", action="store_true",
                     help="skip compiling the engine's cold-start kernel "
                          "ladder at boot (tpu engine)")
+    rn.add_argument("--wire_format", default="columnar",
+                    choices=["columnar", "gojson"],
+                    help="gossip sync payload encoding: columnar = "
+                         "packed per-field binary columns, negotiated "
+                         "per peer with transparent fallback; gojson = "
+                         "the reference's per-event JSON dicts (both "
+                         "forms are always accepted inbound)")
+    rn.add_argument("--max_msg_bytes", type=int, default=32,
+                    help="cap on a single gossip RPC message in MiB "
+                         "(JSON line or binary frame, either "
+                         "direction); oversized messages fail with a "
+                         "clear TransportError")
+    rn.add_argument("--compile_cache_dir", default="",
+                    help="persistent XLA compilation cache directory "
+                         "for the tpu engine (restart-surviving kernel "
+                         "compiles; default ~/.cache/babble_tpu/jax or "
+                         "$JAX_COMPILATION_CACHE_DIR)")
     # -- fault tolerance (docs/robustness.md) ---------------------------
     rn.add_argument("--breaker_threshold", type=int, default=3,
                     help="consecutive sync failures before a peer's "

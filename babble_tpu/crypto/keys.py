@@ -5,10 +5,19 @@ P-256 with signatures as the (R, S) big-int pair; public keys serialized
 as uncompressed X9.62 points (0x04||X||Y, 65 bytes — Go
 elliptic.Marshal).
 
-Backend selection: the `cryptography` package (OpenSSL) when available,
-else the pure-Python fallback (`_fallback.py`) — same wire formats,
-signatures interchangeable. `BACKEND` reports which one is active;
-the import never fails on a missing optional dependency.
+Backend selection, fastest available first — same wire formats,
+signatures interchangeable, and the import never fails on a missing
+optional dependency (`BACKEND` reports which one is active):
+
+1. "openssl"        — the `cryptography` package when installed.
+2. "openssl-ctypes" — no `cryptography`, but the SYSTEM libcrypto is
+   loadable (it ships with CPython's ssl module almost everywhere):
+   sign/verify route through `_openssl.py`'s ctypes binding while key
+   objects stay the pure-Python ones, so PEM and serialization are
+   untouched. ~60x faster than the fallback — the difference between
+   ECDSA being the gossip ingest wall and being noise (docs/ingest.md).
+3. "pure-python"    — `_fallback.py`, always works.
+   `BABBLE_PURE_CRYPTO=1` forces this (CI's no-optional-deps job).
 """
 
 from __future__ import annotations
@@ -84,8 +93,22 @@ else:
     key_from_seed = _fb.key_from_seed
     pub_key_bytes = _fb.pub_key_bytes
     pub_key_from_bytes = _fb.pub_key_from_bytes
-    sign = _fb.sign
-    verify = _fb.verify
+
+    from . import _openssl as _ossl
+
+    if _ossl.available():
+        BACKEND = "openssl-ctypes"
+
+        def sign(key: "_fb.PrivateKey", digest: bytes) -> Tuple[int, int]:
+            return _ossl.sign(key.d, digest)
+
+        def verify(pub: "_fb.PublicKey", digest: bytes,
+                   r: int, s: int) -> bool:
+            return _ossl.verify(pub.to_bytes(), digest, r, s)
+
+    else:
+        sign = _fb.sign
+        verify = _fb.verify
 
 
 @functools.lru_cache(maxsize=1024)

@@ -87,4 +87,20 @@ def ensure_compile_cache(path: str | None = None) -> str:
     # 0.1s floor: engine kernels are worth persisting even when a fast
     # backend compiles them quickly; trivial one-liners are not.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    # Older JAX releases only honor the directory once
+    # compilation_cache.initialize_cache() runs; newer ones read the
+    # config flag lazily and deprecate the explicit call. Try it,
+    # tolerate both its absence and its already-initialized error, so
+    # the cache persists across process restarts on every JAX this
+    # repo supports.
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as cc,
+        )
+
+        init = getattr(cc, "initialize_cache", None)
+        if init is not None:
+            init(cache_dir)
+    except Exception:  # noqa: BLE001 - best-effort on deprecated API
+        pass
     return cache_dir

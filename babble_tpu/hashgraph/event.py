@@ -16,6 +16,7 @@ indexes (reference hashgraph.go:532-614).
 from __future__ import annotations
 
 import base64
+import functools
 from typing import List, Optional, Sequence
 
 from .. import crypto
@@ -415,6 +416,83 @@ class WireEvent(GoStruct):
             s=obj["S"],
             trace_id=obj.get("_TraceID", 0),
         )
+
+
+@functools.lru_cache(maxsize=4096)
+def _creator_b64(creator: bytes) -> str:
+    """Base64 of a creator's public-key bytes — one per participant,
+    reused on every event of the columnar read path."""
+    return base64.b64encode(creator).decode("ascii")
+
+
+@functools.lru_cache(maxsize=4096)
+def _creator_hex(creator: bytes) -> str:
+    return "0x" + creator.hex().upper()
+
+
+def materialize_wire_event(
+    creator_bytes: bytes,
+    self_parent: str,
+    other_parent: str,
+    index: int,
+    ts_ns: int,
+    txs: Optional[List[bytes]],
+    r: int,
+    s: int,
+    sp_idx: int,
+    op_cid: int,
+    op_idx: int,
+    cid: int,
+    trace_id: int = 0,
+) -> Event:
+    """Zero-rebuild materialization of a columnar wire row into a full
+    Event: the Go-JSON body and event encodings are built directly with
+    one f-string each and SEEDED into the marshal memos, so the ingest
+    pipeline's body hash (signature verify), event hash (identity), and
+    any later relay marshal are all cache hits — no GoStruct field walk
+    and no JSON dict ever exists for the event.
+
+    Soundness: every string interpolated below comes from a domain that
+    Go-JSON writes through unescaped (hex hashes, base64, RFC3339Nano,
+    decimal ints), and the field order matches EventBody.go_fields /
+    Event.go_fields exactly — pinned byte-for-byte against the GoStruct
+    encoder by tests/test_wire.py. Because the encoding is DERIVED from
+    the resolved columns, a relay that lies about the wire coordinates
+    still produces a body whose signature check fails, exactly like the
+    legacy read path."""
+    if txs is None:
+        txpart = "null"
+    elif txs:
+        txpart = '["' + '","'.join(
+            base64.b64encode(t).decode("ascii") for t in txs) + '"]'
+    else:
+        txpart = "[]"
+    ts = Timestamp(ts_ns)
+    body_str = (
+        '{"Transactions":' + txpart
+        + ',"Parents":["' + self_parent + '","' + other_parent
+        + '"],"Creator":"' + _creator_b64(creator_bytes)
+        + '","Timestamp":"' + ts.rfc3339nano()
+        + '","Index":' + str(index) + "}"
+    )
+    body = EventBody(
+        transactions=txs,
+        parents=[self_parent, other_parent],
+        creator=creator_bytes,
+        timestamp=ts,
+        index=index,
+    )
+    body._marshal_str = body_str
+    body.self_parent_index = sp_idx
+    body.other_parent_creator_id = op_cid
+    body.other_parent_index = op_idx
+    body.creator_id = cid
+    ev = Event(body, r=r, s=s)
+    ev._marshal_str = (
+        '{"Body":' + body_str + ',"R":' + str(r) + ',"S":' + str(s) + "}")
+    ev._creator_hex = _creator_hex(creator_bytes)
+    ev.trace_id = trace_id
+    return ev
 
 
 def by_topological_order(events: List[Event]) -> List[Event]:

@@ -88,6 +88,15 @@ class Hashgraph:
         self._strongly_see_cache = Memo(cache_size)
         self._parent_round_cache = Memo(cache_size)
         self._round_cache = Memo(cache_size)
+        self._witness_cache = Memo(cache_size)
+        # Events already recorded into their RoundInfo by a previous
+        # divide_rounds pass: round(x)/witness(x) are pure functions of
+        # the DAG and RoundInfo.add_event is idempotent, so re-walking
+        # them every pass (the reference rescans ALL undetermined
+        # events, hashgraph.go:616-646) only re-derives identical
+        # state. The set tracks what is already divided so each pass
+        # costs O(new events), not O(undetermined backlog).
+        self._divided: set = set()
 
     # -- reachability ------------------------------------------------------
 
@@ -223,6 +232,14 @@ class Hashgraph:
         return res
 
     def witness(self, x: str) -> bool:
+        c, ok = self._witness_cache.get(x)
+        if ok:
+            return c
+        w = self._witness(x)
+        self._witness_cache.add(x, w)
+        return w
+
+    def _witness(self, x: str) -> bool:
         try:
             ex = self.store.get_event(x)
             root = self.store.get_root(ex.creator())
@@ -458,25 +475,14 @@ class Hashgraph:
         ev.trace_id = wevent.trace_id
         return ev
 
-    def read_wire_batch(self, wire_events: List[WireEvent]) -> List[Event]:
-        """Materialize a whole sync batch of wire events at once.
-
-        Equivalent to calling read_wire_info per event interleaved with
-        inserts, but with two batch-level shortcuts:
-
-        - later batch events routinely name earlier ones as parents;
-          those coordinates resolve against a local (creator_id, index)
-          map of the batch itself instead of requiring the parent to be
-          store-inserted first — which is what lets `Core.sync` split
-          materialize / verify / insert into separate phases;
-        - store coordinates resolve through ONE per-creator window
-          snapshot (`participant_window`) instead of two store probes
-          per event (for a FileStore whose window aged out, that was
-          two sqlite round trips per event).
-
-        Caller holds the core lock: the window snapshots are live store
-        state and must not race inserts.
-        """
+    def _batch_resolver(self):
+        """(local, resolve) pair shared by the legacy and columnar
+        batch read paths: `local` maps (creator_id, index) -> hex for
+        events materialized earlier in the same batch; `resolve` falls
+        through to ONE per-creator window snapshot, then the per-event
+        store probe (which raises the same StoreError the serial path
+        raised). Caller holds the core lock: the window snapshots are
+        live store state and must not race inserts."""
         local: Dict[tuple, str] = {}
         windows: Dict[int, tuple] = {}
 
@@ -494,11 +500,38 @@ class Hashgraph:
             if 0 <= pos < len(items):
                 return items[pos]
             # Aged out of the rolling window (or unknown): fall back to
-            # the per-event store probe, which raises the same
-            # StoreError the serial path raised.
+            # the per-event store probe.
             creator = self.reverse_participants[creator_id]
             return self.store.participant_event(creator, index)
 
+        return local, resolve
+
+    def read_wire_batch(self, wire_events) -> List[Event]:
+        """Materialize a whole sync batch of wire events at once.
+
+        Accepts either the legacy `List[WireEvent]` or a packed
+        `ColumnarEvents` batch (net/columnar.py) — the two wire forms
+        of the same payload, so mixed-format clusters converge on the
+        same DAG bytes.
+
+        Equivalent to calling read_wire_info per event interleaved with
+        inserts, but with two batch-level shortcuts:
+
+        - later batch events routinely name earlier ones as parents;
+          those coordinates resolve against a local (creator_id, index)
+          map of the batch itself instead of requiring the parent to be
+          store-inserted first — which is what lets `Core.sync` split
+          materialize / verify / insert into separate phases;
+        - store coordinates resolve through ONE per-creator window
+          snapshot (`participant_window`) instead of two store probes
+          per event (for a FileStore whose window aged out, that was
+          two sqlite round trips per event).
+
+        Caller holds the core lock.
+        """
+        if not isinstance(wire_events, list):
+            return self._read_columnar_batch(wire_events)
+        local, resolve = self._batch_resolver()
         out: List[Event] = []
         for wevent in wire_events:
             wb = wevent.body
@@ -530,10 +563,61 @@ class Hashgraph:
             out.append(ev)
         return out
 
+    def _read_columnar_batch(self, cols) -> List[Event]:
+        """Columnar materialization (docs/ingest.md "Wire layout"):
+        walk the packed columns once, resolve parents through the same
+        batch-local map + window snapshots as the legacy path, and
+        build each Event via `materialize_wire_event` — the Go-JSON
+        body/event encodings are seeded directly from the columns, so
+        downstream hashing, signature verification (over the derived
+        signed-body blob column), and relay marshal are all memo hits.
+        No per-event wire dict is ever built."""
+        from .event import materialize_wire_event
+
+        local, resolve = self._batch_resolver()
+        cid = cols.cid.tolist()
+        idx = cols.idx.tolist()
+        sp_idx = cols.sp_idx.tolist()
+        op_cid = cols.op_cid.tolist()
+        op_idx = cols.op_idx.tolist()
+        ts_ns = cols.ts_ns.tolist()
+        trace = (cols.trace_ids.tolist()
+                 if cols.trace_ids is not None else None)
+        tx_starts, tx_off = cols.tx_layout()
+        creator_bytes: Dict[int, bytes] = {}
+
+        out: List[Event] = []
+        for k in range(len(cid)):
+            c = cid[k]
+            cb = creator_bytes.get(c)
+            if cb is None:
+                cb = creator_bytes[c] = bytes.fromhex(
+                    self.reverse_participants[c][2:])
+            self_parent = resolve(c, sp_idx[k]) if sp_idx[k] >= 0 else ""
+            other_parent = (resolve(op_cid[k], op_idx[k])
+                            if op_idx[k] >= 0 else "")
+            r, s = cols.signature(k)
+            ev = materialize_wire_event(
+                cb, self_parent, other_parent, idx[k], ts_ns[k],
+                cols.transactions_of(tx_starts, tx_off, k), r, s,
+                sp_idx[k], op_cid[k], op_idx[k], c,
+                trace_id=trace[k] if trace is not None else 0,
+            )
+            local[(c, idx[k])] = ev.hex()
+            out.append(ev)
+        return out
+
     # -- consensus pipeline ------------------------------------------------
 
     def divide_rounds(self) -> None:
+        divided = self._divided
         for ehex in self.undetermined_events:
+            if ehex in divided:
+                # Already recorded by a previous pass: its round and
+                # witness flag are memo-stable and its RoundInfo row
+                # already holds it — rescanning is a provable no-op
+                # (the reference's rescan re-derives identical state).
+                continue
             round_number = self.round(ehex)
             witness = self.witness(ehex)
             try:
@@ -547,6 +631,7 @@ class Hashgraph:
                 round_info.queued = True
             round_info.add_event(ehex, witness)
             self.store.set_round(round_number, round_info)
+            divided.add(ehex)
 
     def decide_fame(self) -> None:
         votes: Dict[str, Dict[str, bool]] = {}
@@ -618,9 +703,18 @@ class Hashgraph:
         self.last_commited_round_events = self.store.round_events(i - 1)
 
     def decide_round_received(self) -> None:
+        # The gate below (all rounds <= i decided) fails for every i at
+        # or past the first undecided round, so that is the hard upper
+        # bound of the scan — computed once per pass, and events whose
+        # round leaves no candidate i skip the loop (and its get_round
+        # probe) entirely.
+        first_undecided = (self.undecided_rounds[0]
+                           if self.undecided_rounds
+                           else MAX_INT32)
+        last = min(self.store.last_round(), first_undecided - 1)
         for x in self.undetermined_events:
             r = self.round(x)
-            for i in range(r + 1, self.store.last_round() + 1):
+            for i in range(r + 1, last + 1):
                 try:
                     tr = self.store.get_round(i)
                 except StoreError as err:
@@ -629,14 +723,10 @@ class Hashgraph:
                     tr = RoundInfo()
 
                 # Skip until the round is fully decided and all earlier
-                # rounds are too (hashgraph.go:762-764). Once i reaches
-                # the first undecided round the gate fails for EVERY
-                # larger i (it is monotone in i), so scanning on is
-                # provably all no-ops — break instead (the reference
-                # continues, to the same outcome, at O(last_round) per
-                # event).
-                if self.undecided_rounds and self.undecided_rounds[0] <= i:
-                    break
+                # rounds are too (hashgraph.go:762-764); i stops before
+                # the first undecided round (the gate is monotone in i,
+                # the reference continues to the same outcome at
+                # O(last_round) per event).
                 if not tr.witnesses_decided():
                     continue
 
@@ -662,6 +752,10 @@ class Hashgraph:
             else:
                 new_undetermined.append(x)
         self.undetermined_events = new_undetermined
+        # Events leaving the undetermined set leave the divided set
+        # too (divide_rounds only consults it for undetermined ones).
+        self._divided.difference_update(
+            e.hex() for e in new_consensus_events)
 
         # ConsensusSorter quirk (consensus_sorter.go:44-52): its round map is
         # never populated, so PseudoRandomNumber is always 0 and the final

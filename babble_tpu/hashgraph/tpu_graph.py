@@ -108,6 +108,71 @@ class TpuHashgraph(Hashgraph):
         if event.is_loaded():
             self.pending_loaded_events += 1
 
+    def insert_wire_batch(self, events: List[Event]) -> None:
+        """Device-direct batch insert (docs/ingest.md): the host-side
+        checks (signature memo, parent checks, topo index, Store
+        mirror) run per event exactly as insert_event does, but the
+        engine append is DEFERRED into numpy staging columns and landed
+        with ONE vectorized `append_batch` slice-assign — the columnar
+        wire batch flows socket -> columns -> engine staging buffers
+        without a per-event engine call.
+
+        Failure semantics match the serial loop: a bad event aborts at
+        its batch position with the validated prefix inserted (the
+        finally flushes the staged prefix so `_eid_of` never points at
+        ids the engine does not have)."""
+        if not events:
+            return
+        engine = self.engine
+        e0 = engine.e
+        sp_col: List[int] = []
+        op_col: List[int] = []
+        cr_col: List[int] = []
+        idx_col: List[int] = []
+        coin_col: List[bool] = []
+        ts_col: List[int] = []
+        try:
+            for ev in events:
+                if not ev.verify():
+                    raise InsertError("Invalid signature")
+                try:
+                    self._check_self_parent(ev)
+                except Exception as e:
+                    raise InsertError(f"CheckSelfParent: {e}") from e
+                try:
+                    self._check_other_parent(ev)
+                except Exception as e:
+                    raise InsertError(f"CheckOtherParent: {e}") from e
+
+                ev.topological_index = self.topological_index
+                self.topological_index += 1
+
+                ehex = ev.hex()
+                sp_col.append(self._eid_of.get(ev.self_parent(), -1))
+                op_col.append(self._eid_of.get(ev.other_parent(), -1))
+                cr_col.append(self.participants[ev.creator()])
+                idx_col.append(ev.index())
+                coin_col.append(middle_bit(ehex))
+                ts_col.append(ev.body.timestamp.ns)
+                eid = e0 + len(sp_col) - 1
+                self._eid_of[ehex] = eid
+                self._hex_by_id.append(ehex)
+
+                self.store.set_event(ev)
+                self.undetermined_events.append(ehex)
+                if ev.is_loaded():
+                    self.pending_loaded_events += 1
+        finally:
+            if sp_col:
+                got = engine.append_batch(
+                    np.asarray(sp_col, np.int32),
+                    np.asarray(op_col, np.int32),
+                    np.asarray(cr_col, np.int32),
+                    np.asarray(idx_col, np.int32),
+                    np.asarray(coin_col, np.bool_),
+                    np.asarray(ts_col, np.int64))
+                assert got == e0
+
     # -- consensus: one device pipeline call + Store mirroring --------------
 
     def run_consensus(self, unlocked=None) -> None:

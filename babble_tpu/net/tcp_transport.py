@@ -1,4 +1,4 @@
-"""TCP transport with the reference wire protocol.
+"""TCP transport: reference wire protocol + negotiated columnar frames.
 
 Reference net/net_transport.go:33-46,147-390 + tcp_transport.go:48-91:
 - request: 1 framing byte (0x00 Sync, 0x01 EagerSync, 0x02 FastForward)
@@ -12,16 +12,41 @@ Reference net/net_transport.go:33-46,147-390 + tcp_transport.go:48-91:
 Bodies are encoded exactly as Go's encoding/json would (field names,
 base64 []byte, RFC3339Nano timestamps), one JSON value per line — Go's
 json.Encoder also terminates values with '\n', so the framing is
-byte-compatible in both directions."""
+byte-compatible in both directions.
+
+Columnar extension (docs/ingest.md "Wire layout"): two extra frame
+types move sync payloads as length-prefixed binary columns
+(net/columnar.py) instead of base64-inside-JSON-inside-readline —
+
+    0x03 SyncColumnar:      JSON request line; response = JSON error
+                            line + [u32 len][JSON header][columns]
+    0x04 EagerSyncColumnar: request = [u32 len][JSON header][columns];
+                            response = JSON error line + JSON payload
+    0x7E WireHello:         JSON {"Wire": [versions]} -> JSON
+                            {"Wire": chosen}; negotiates per peer
+
+Negotiation is per-target and transparent: the first columnar-eligible
+RPC to a peer sends WireHello on the pooled connection. A legacy peer
+answers it with its normal "unknown rpc type" error — the hello body
+is a plain JSON line, so the legacy handler stays framed and the
+connection survives — and the sender falls back to the Go-JSON forms
+(downconverting any ColumnarEvents payload), preserving mixed-cluster
+interop. Every frame (JSON or binary) is capped at `max_msg_bytes`; an
+oversized message raises TransportError instead of growing an
+unbounded readline buffer.
+"""
 
 from __future__ import annotations
 
 import json
 import queue
 import socket
+import struct
 import threading
 from typing import Dict, List, Optional
 
+from ..telemetry import get_registry
+from .columnar import ColumnarEvents, WIRE_VERSION
 from .transport import (
     FastForwardRequest,
     FastForwardResponse,
@@ -37,6 +62,11 @@ from .transport import (
 RPC_SYNC = 0x00
 RPC_EAGER_SYNC = 0x01
 RPC_FAST_FORWARD = 0x02
+RPC_SYNC_COL = 0x03
+RPC_EAGER_SYNC_COL = 0x04
+RPC_WIRE_HELLO = 0x7E
+
+DEFAULT_MAX_MSG_BYTES = 32 << 20
 
 
 def _b64_bytes(obj):
@@ -48,20 +78,54 @@ def _b64_bytes(obj):
 
 
 class _Conn:
-    """One pooled connection: socket + buffered reader."""
+    """One pooled connection: socket + buffered reader. `count` is the
+    transport's wire-byte accounting hook (format, direction, n)."""
 
-    def __init__(self, sock: socket.socket):
+    def __init__(self, sock: socket.socket, max_msg_bytes: int, count):
         self.sock = sock
         self.reader = sock.makefile("rb")
+        self.max_msg = max_msg_bytes
+        self.count = count
 
     def send_json(self, obj) -> None:
-        self.sock.sendall(json.dumps(obj, default=_b64_bytes).encode() + b"\n")
+        data = json.dumps(obj, default=_b64_bytes).encode() + b"\n"
+        self.count("gojson", "tx", len(data))
+        self.sock.sendall(data)
 
     def recv_json(self):
-        line = self.reader.readline()
+        # readline with a hard cap: a misbehaving peer streaming an
+        # endless unterminated line must hit a clear error, not an
+        # unbounded buffer.
+        line = self.reader.readline(self.max_msg + 1)
         if not line:
             raise TransportError("connection closed")
+        if len(line) > self.max_msg:
+            raise TransportError(
+                f"message exceeds max_msg_bytes ({self.max_msg})")
+        self.count("gojson", "rx", len(line))
         return json.loads(line)
+
+    def send_frame(self, payload: bytes) -> None:
+        self.count("columnar", "tx", len(payload) + 4)
+        self.sock.sendall(struct.pack(">I", len(payload)))
+        self.sock.sendall(payload)
+
+    def recv_frame(self) -> bytes:
+        head = self._read_exact(4)
+        (n,) = struct.unpack(">I", head)
+        if n > self.max_msg:
+            raise TransportError(
+                f"frame of {n} bytes exceeds max_msg_bytes "
+                f"({self.max_msg})")
+        payload = self._read_exact(n)
+        self.count("columnar", "rx", n + 4)
+        return payload
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = self.reader.read(n)
+        if buf is None or len(buf) < n:
+            raise TransportError("connection closed mid-frame")
+        return buf
 
     def close(self) -> None:
         try:
@@ -74,6 +138,61 @@ class _Conn:
             pass
 
 
+def _pack_sync_response(resp: SyncResponse) -> bytes:
+    """[u32 header len][header JSON][columns] — the header is the
+    normal SyncResponse dict minus Events (clock stamps included)."""
+    events = resp.events
+    if isinstance(events, list):
+        events = ColumnarEvents.from_wire_events(events)
+    header = {
+        "FromID": resp.from_id,
+        "SyncLimit": resp.sync_limit,
+        "Known": {str(k): v for k, v in resp.known.items()},
+    }
+    if resp.t_recv:
+        header["ClockOrigin"] = resp.t_origin
+        header["ClockRecv"] = resp.t_recv
+        header["ClockReply"] = resp.t_reply
+    hb = json.dumps(header).encode()
+    return struct.pack(">I", len(hb)) + hb + events.encode()
+
+
+def _unpack_sync_response(buf: bytes) -> SyncResponse:
+    if len(buf) < 4:
+        raise TransportError("short columnar sync response")
+    (hlen,) = struct.unpack_from(">I", buf)
+    header = json.loads(buf[4:4 + hlen])
+    resp = SyncResponse(
+        from_id=header["FromID"],
+        sync_limit=header.get("SyncLimit", False),
+        known={int(k): v for k, v in (header.get("Known") or {}).items()},
+        t_origin=header.get("ClockOrigin", 0),
+        t_recv=header.get("ClockRecv", 0),
+        t_reply=header.get("ClockReply", 0),
+    )
+    resp.events = ColumnarEvents.decode(buf[4 + hlen:])
+    return resp
+
+
+def _pack_eager_request(req: EagerSyncRequest) -> bytes:
+    events = req.events
+    if isinstance(events, list):
+        events = ColumnarEvents.from_wire_events(events)
+    hb = json.dumps({"FromID": req.from_id}).encode()
+    return struct.pack(">I", len(hb)) + hb + events.encode()
+
+
+def _unpack_eager_request(buf: bytes) -> EagerSyncRequest:
+    if len(buf) < 4:
+        raise TransportError("short columnar eager request")
+    (hlen,) = struct.unpack_from(">I", buf)
+    header = json.loads(buf[4:4 + hlen])
+    return EagerSyncRequest(
+        from_id=header["FromID"],
+        events=ColumnarEvents.decode(buf[4 + hlen:]),
+    )
+
+
 class TCPTransport:
     def __init__(
         self,
@@ -83,6 +202,8 @@ class TCPTransport:
         timeout: float = 1.0,
         response_timeout: Optional[float] = None,
         consumer_buffer: int = 16,
+        wire_format: str = "columnar",
+        max_msg_bytes: int = DEFAULT_MAX_MSG_BYTES,
     ):
         """`timeout` bounds outbound socket operations; a connection
         handler waits `response_timeout` (default 10x timeout) for the
@@ -90,7 +211,11 @@ class TCPTransport:
         timeout to the caller. `consumer_buffer` caps queued inbound
         RPCs — when it is full the handler answers with a
         TransportError immediately instead of stalling its connection
-        (overload is signalled, not absorbed)."""
+        (overload is signalled, not absorbed). `wire_format`
+        ("columnar" | "gojson") picks the preferred sync payload
+        encoding; columnar is negotiated per peer with transparent
+        legacy fallback. `max_msg_bytes` bounds any single JSON line or
+        binary frame in either direction."""
         host, port_s = bind_addr.rsplit(":", 1)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -109,12 +234,29 @@ class TCPTransport:
         self._response_timeout = (
             response_timeout if response_timeout is not None
             else timeout * 10)
+        self._wire_format = wire_format
+        self._max_msg_bytes = max_msg_bytes
+        # Per-target negotiated wire: True = peer speaks columnar,
+        # False = legacy. Absent = not yet negotiated.
+        self._peer_columnar: Dict[str, bool] = {}
+        self._wire_lock = threading.Lock()
+        reg = get_registry()
+        self._byte_counters = {
+            (fmt, d): reg.counter(
+                "babble_wire_bytes_total",
+                "Bytes moved on the gossip wire by payload format and "
+                "direction", format=fmt, dir=d)
+            for fmt in ("gojson", "columnar") for d in ("tx", "rx")
+        }
         self._shutdown = threading.Event()
         self._threads: List[threading.Thread] = []
 
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
         self._threads.append(t)
+
+    def _count(self, fmt: str, direction: str, n: int) -> None:
+        self._byte_counters[(fmt, direction)].inc(n)
 
     # -- Transport interface ----------------------------------------------
 
@@ -125,10 +267,19 @@ class TCPTransport:
         return self._addr
 
     def sync(self, target: str, args: SyncRequest) -> SyncResponse:
+        if self._use_columnar(target):
+            args.wire = WIRE_VERSION
+            out = self._columnar_sync_rpc(target, args.to_dict())
+            return out
+        args.wire = ""
         out = self._generic_rpc(target, RPC_SYNC, args.to_dict())
         return SyncResponse.from_dict(out)
 
     def eager_sync(self, target: str, args: EagerSyncRequest) -> EagerSyncResponse:
+        if self._use_columnar(target):
+            out = self._columnar_eager_rpc(target, args)
+            return EagerSyncResponse.from_dict(out)
+        # Legacy peer: downconvert a columnar payload transparently.
         out = self._generic_rpc(target, RPC_EAGER_SYNC, args.to_dict())
         return EagerSyncResponse.from_dict(out)
 
@@ -159,7 +310,7 @@ class TCPTransport:
         host, port_s = target.rsplit(":", 1)
         sock = socket.create_connection((host, int(port_s)), timeout=self._timeout)
         sock.settimeout(self._timeout)
-        return _Conn(sock)
+        return _Conn(sock, self._max_msg_bytes, self._count)
 
     def _return_conn(self, target: str, conn: _Conn) -> None:
         with self._pool_lock:
@@ -169,11 +320,84 @@ class TCPTransport:
                 return
         conn.close()
 
+    def _use_columnar(self, target: str) -> bool:
+        """Negotiated wire for `target`, running the WireHello handshake
+        on first contact. Failures mark the peer legacy for this
+        process lifetime — the RPC that follows still goes through on
+        the Go-JSON forms."""
+        if self._wire_format != "columnar":
+            return False
+        with self._wire_lock:
+            got = self._peer_columnar.get(target)
+        if got is not None:
+            return got
+        ok = False
+        try:
+            conn = self._get_conn(target)
+            try:
+                conn.sock.sendall(bytes([RPC_WIRE_HELLO]))
+                conn.send_json({"Wire": [WIRE_VERSION]})
+                rpc_error = conn.recv_json()
+                payload = conn.recv_json()
+                ok = (not rpc_error
+                      and payload.get("Wire") == WIRE_VERSION)
+            except (OSError, ValueError, TransportError):
+                conn.close()
+                raise
+            self._return_conn(target, conn)
+        except TransportError:
+            raise
+        except (OSError, ValueError) as exc:
+            raise TransportError(
+                f"wire hello to {target} failed: {exc}") from exc
+        with self._wire_lock:
+            self._peer_columnar[target] = ok
+        return ok
+
     def _generic_rpc(self, target: str, rpc_type: int, body: dict) -> dict:
         conn = self._get_conn(target)
         try:
             conn.sock.sendall(bytes([rpc_type]))
             conn.send_json(body)
+            rpc_error = conn.recv_json()
+            resp = conn.recv_json()
+        except (OSError, ValueError, TransportError) as exc:
+            conn.close()
+            raise TransportError(f"rpc to {target} failed: {exc}") from exc
+        if rpc_error:
+            conn.close()
+            raise TransportError(f"rpc error: {rpc_error}")
+        self._return_conn(target, conn)
+        return resp
+
+    def _columnar_sync_rpc(self, target: str, body: dict) -> SyncResponse:
+        conn = self._get_conn(target)
+        try:
+            conn.sock.sendall(bytes([RPC_SYNC_COL]))
+            conn.send_json(body)
+            rpc_error = conn.recv_json()
+            frame = conn.recv_frame() if not rpc_error else b""
+        except (OSError, ValueError, TransportError) as exc:
+            conn.close()
+            raise TransportError(f"rpc to {target} failed: {exc}") from exc
+        if rpc_error:
+            conn.close()
+            raise TransportError(f"rpc error: {rpc_error}")
+        self._return_conn(target, conn)
+        try:
+            return _unpack_sync_response(frame)
+        except (ValueError, KeyError) as exc:
+            raise TransportError(
+                f"malformed columnar response from {target}: {exc}"
+            ) from exc
+
+    def _columnar_eager_rpc(self, target: str,
+                            args: EagerSyncRequest) -> dict:
+        frame = _pack_eager_request(args)
+        conn = self._get_conn(target)
+        try:
+            conn.sock.sendall(bytes([RPC_EAGER_SYNC_COL]))
+            conn.send_frame(frame)
             rpc_error = conn.recv_json()
             resp = conn.recv_json()
         except (OSError, ValueError, TransportError) as exc:
@@ -198,45 +422,71 @@ class TCPTransport:
             t.start()
 
     def _handle_conn(self, sock: socket.socket) -> None:
-        conn = _Conn(sock)
+        conn = _Conn(sock, self._max_msg_bytes, self._count)
         try:
             while not self._shutdown.is_set():
                 t = conn.reader.read(1)
                 if not t:
                     return
-                body = conn.recv_json()
+                wire = ""
+                if t[0] == RPC_WIRE_HELLO:
+                    offers = conn.recv_json().get("Wire") or []
+                    speak = (WIRE_VERSION
+                             if (self._wire_format == "columnar"
+                                 and WIRE_VERSION in offers)
+                             else "gojson")
+                    conn.send_json("")
+                    conn.send_json({"Wire": speak})
+                    continue
                 if t[0] == RPC_SYNC:
-                    cmd = SyncRequest.from_dict(body)
+                    cmd = SyncRequest.from_dict(conn.recv_json())
+                elif t[0] == RPC_SYNC_COL:
+                    cmd = SyncRequest.from_dict(conn.recv_json())
+                    cmd.wire = WIRE_VERSION
+                    wire = "columnar"
                 elif t[0] == RPC_EAGER_SYNC:
-                    cmd = EagerSyncRequest.from_dict(body)
+                    cmd = EagerSyncRequest.from_dict(conn.recv_json())
+                elif t[0] == RPC_EAGER_SYNC_COL:
+                    cmd = _unpack_eager_request(conn.recv_frame())
                 elif t[0] == RPC_FAST_FORWARD:
-                    cmd = FastForwardRequest.from_dict(body)
+                    cmd = FastForwardRequest.from_dict(conn.recv_json())
                 else:
                     conn.send_json(f"unknown rpc type {t[0]}")
                     conn.send_json({})
                     continue
 
-                rpc = RPC(cmd)
+                rpc = RPC(cmd, wire=wire)
                 try:
                     self._consumer.put_nowait(rpc)
                 except queue.Full:
                     # Overloaded node: fail the RPC immediately instead
                     # of blocking this handler thread (which would also
                     # stall every later RPC on this connection).
-                    conn.send_json("consumer queue full")
-                    conn.send_json({})
+                    self._respond_error(conn, wire, "consumer queue full")
                     continue
                 try:
                     rpc_resp = rpc.resp_chan.get(
                         timeout=self._response_timeout)
                 except queue.Empty:
-                    conn.send_json("rpc handler timed out")
-                    conn.send_json({})
+                    self._respond_error(conn, wire, "rpc handler timed out")
                     continue
-                conn.send_json(str(rpc_resp.error) if rpc_resp.error else "")
+                err = str(rpc_resp.error) if rpc_resp.error else ""
                 payload = rpc_resp.response
-                conn.send_json(payload.to_dict() if payload is not None else {})
+                if wire == "columnar":
+                    conn.send_json(err)
+                    if err:
+                        continue
+                    conn.send_frame(_pack_sync_response(payload))
+                else:
+                    conn.send_json(err)
+                    conn.send_json(
+                        payload.to_dict() if payload is not None else {})
         except (OSError, ValueError, TransportError):
             pass
         finally:
             conn.close()
+
+    def _respond_error(self, conn: _Conn, wire: str, msg: str) -> None:
+        conn.send_json(msg)
+        if wire != "columnar":
+            conn.send_json({})

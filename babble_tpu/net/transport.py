@@ -29,12 +29,21 @@ class SyncRequest:
     # pre-handshake wire form is unchanged and Go-style decoders
     # ignore the extra key either way.
     t_send: int = 0
+    # Requested response payload format (net/columnar.py): "" = the
+    # legacy Go-JSON event list; the columnar version token asks the
+    # responder for a packed `ColumnarEvents` payload if it speaks it.
+    # Same sidecar contract as ClockSend: only present when set, so
+    # the legacy wire bytes are unchanged and legacy decoders ignore
+    # the extra key.
+    wire: str = ""
 
     def to_dict(self) -> dict:
         d = {"FromID": self.from_id,
              "Known": {str(k): v for k, v in self.known.items()}}
         if self.t_send:
             d["ClockSend"] = self.t_send
+        if self.wire:
+            d["Wire"] = self.wire
         return d
 
     @classmethod
@@ -43,6 +52,7 @@ class SyncRequest:
             from_id=d["FromID"],
             known={int(k): v for k, v in (d.get("Known") or {}).items()},
             t_send=d.get("ClockSend", 0),
+            wire=d.get("Wire", ""),
         )
 
 
@@ -50,7 +60,10 @@ class SyncRequest:
 class SyncResponse:
     from_id: int
     sync_limit: bool = False
-    events: List[WireEvent] = field(default_factory=list)
+    # Legacy List[WireEvent] or a packed ColumnarEvents batch
+    # (net/columnar.py) — Core.sync accepts both; to_dict downconverts
+    # so a columnar payload can still ride the legacy JSON framing.
+    events: object = field(default_factory=list)
     known: Dict[int, int] = field(default_factory=dict)
     # Clock handshake echo: the request's ClockSend (t0), the
     # responder's receive stamp (t1, taken when the RPC object was
@@ -62,10 +75,13 @@ class SyncResponse:
     t_reply: int = 0
 
     def to_dict(self) -> dict:
+        events = self.events
+        if not isinstance(events, list):
+            events = events.to_wire_events()
         d = {
             "FromID": self.from_id,
             "SyncLimit": self.sync_limit,
-            "Events": [e.to_dict() for e in self.events],
+            "Events": [e.to_dict() for e in events],
             "Known": {str(k): v for k, v in self.known.items()},
         }
         if self.t_recv:
@@ -90,12 +106,16 @@ class SyncResponse:
 @dataclass
 class EagerSyncRequest:
     from_id: int
-    events: List[WireEvent] = field(default_factory=list)
+    # Legacy List[WireEvent] or a packed ColumnarEvents batch.
+    events: object = field(default_factory=list)
 
     def to_dict(self) -> dict:
+        events = self.events
+        if not isinstance(events, list):
+            events = events.to_wire_events()
         return {
             "FromID": self.from_id,
-            "Events": [e.to_dict() for e in self.events],
+            "Events": [e.to_dict() for e in events],
         }
 
     @classmethod
@@ -175,12 +195,17 @@ class RPC:
     the closest thing to wire arrival every transport can offer
     without protocol changes (the node rebases it onto its epoch)."""
 
-    __slots__ = ("command", "resp_chan", "recv_pc_ns")
+    __slots__ = ("command", "resp_chan", "recv_pc_ns", "wire")
 
-    def __init__(self, command, resp_chan: Optional[queue.Queue] = None):
+    def __init__(self, command, resp_chan: Optional[queue.Queue] = None,
+                 wire: str = ""):
         self.command = command
         self.resp_chan = resp_chan if resp_chan is not None else queue.Queue(1)
         self.recv_pc_ns = time.perf_counter_ns()
+        # Wire format the response must be framed in ("" = legacy
+        # Go-JSON): set by the TCP transport from the inbound frame
+        # type so the columnar negotiation stays transport-local.
+        self.wire = wire
 
     def respond(self, resp, err: Optional[Exception] = None) -> None:
         self.resp_chan.put(RPCResponse(resp, err))

@@ -27,6 +27,17 @@ class Config:
     # fsyncs entirely (fastest; atomic under process death, not power
     # loss).
     store_sync: str = "batch"  # "always" | "batch" | "off"
+    # Gossip sync payload encoding (docs/ingest.md "Wire layout"):
+    # "columnar" packs a sync batch as contiguous per-field columns
+    # (binary frames on TCP, negotiated per peer with transparent
+    # legacy fallback); "gojson" pins the reference's per-event
+    # Go-JSON dicts. Either side of a mixed cluster accepts both, so
+    # the knob only controls what THIS node sends/serves.
+    wire_format: str = "columnar"
+    # Cap on any single gossip RPC message (one JSON line or one binary
+    # columnar frame, either direction): a misbehaving peer hits a
+    # clear TransportError instead of growing an unbounded buffer.
+    max_msg_bytes: int = 32 << 20
     # Consensus engine: "host" (incremental reference-semantics Python)
     # or "tpu" (batched device pipeline behind the same seam).
     engine: str = "host"
@@ -70,6 +81,12 @@ class Config:
     # window inputs read pass k's committed result carries, so only one
     # pass can be in flight per engine.
     pipeline_depth: int = 1
+    # Persistent XLA compilation cache directory for the device engine
+    # (devices.ensure_compile_cache): restarts and sibling testnet
+    # processes reuse compiled consensus kernels instead of re-paying
+    # 5-15s of cold-start compiles per engine. "" = the default
+    # (~/.cache/babble_tpu/jax, or $JAX_COMPILATION_CACHE_DIR).
+    compile_cache_dir: str = ""
     # Compile the device engine's cold-start kernel ladder at node
     # construction (IncrementalEngine.prewarm) instead of stalling the
     # first live syncs on it. Skipped automatically when the scratch

@@ -39,6 +39,7 @@ class Core:
         verify_workers: int = -1,
         trace: Optional[SpanRing] = None,
         registry: Optional[Registry] = None,
+        compile_cache_dir: str = "",
     ):
         self.id = id
         self.key = key
@@ -59,7 +60,8 @@ class Core:
             # (not just the CLI path): restarts — and each process of a
             # localhost testnet — reuse compiled consensus kernels
             # instead of re-paying tens of seconds of compiles.
-            ensure_compile_cache()
+            # Config.compile_cache_dir overrides the default location.
+            ensure_compile_cache(compile_cache_dir or None)
 
             mesh = None
             if engine_mesh and engine_mesh > 1:
@@ -332,9 +334,16 @@ class Core:
         self._merge_store_phases()
         self._timed("sync", t_sync)
 
-    def _sync_batch(self, unknown: List[WireEvent], unlocked=None) -> None:
+    def _sync_batch(self, unknown, unlocked=None) -> None:
+        # Columnar batches get a wire_unpack stamp (the column ->
+        # Event materialization is the unpack; the legacy path's JSON
+        # decode happened in the transport) so /debug/phases splits the
+        # sync wall into marshal vs graph work (docs/ingest.md).
+        columnar = not isinstance(unknown, list)
         t0 = time.perf_counter_ns()
         events = self.hg.read_wire_batch(unknown)
+        if columnar:
+            self._timed("wire_unpack", t0)
         self._timed("from_wire", t0)
 
         t0 = time.perf_counter_ns()
@@ -361,18 +370,38 @@ class Core:
         store = self.hg.store
         store.begin_batch()
         try:
-            for k, ev in enumerate(events):
-                if not has_event(ev.hex()):
-                    self.insert_event(ev, False)
+            batch_insert = getattr(self.hg, "insert_wire_batch", None)
+            if batch_insert is not None and columnar:
+                # Device-direct seam: hand the whole fresh batch to the
+                # engine's vectorized append staging in one call. Head
+                # selection below matches the serial loop: the peer's
+                # head is the LAST event of its diff even when that
+                # event was skipped as a duplicate.
+                fresh = [ev for ev in events if not has_event(ev.hex())]
+                batch_insert(fresh)
+                my_hex = self.hex_id()
+                for ev in fresh:
                     if ev.trace_id:
                         traced.append(ev.trace_id)
-                if k == len(events) - 1:
-                    # Head selection: the peer's head is the LAST event
-                    # of its diff even when that event was skipped as a
-                    # duplicate (its stored copy may differ in wire
-                    # indexes, but the hash covers only {Body, R, S},
-                    # so the hex names the stored copy identically).
-                    other_head = ev.hex()
+                    if ev.creator() == my_hex:
+                        self.head = ev.hex()
+                        self.seq = ev.index()
+                if events:
+                    other_head = events[-1].hex()
+            else:
+                for k, ev in enumerate(events):
+                    if not has_event(ev.hex()):
+                        self.insert_event(ev, False)
+                        if ev.trace_id:
+                            traced.append(ev.trace_id)
+                    if k == len(events) - 1:
+                        # Head selection: the peer's head is the LAST
+                        # event of its diff even when that event was
+                        # skipped as a duplicate (its stored copy may
+                        # differ in wire indexes, but the hash covers
+                        # only {Body, R, S}, so the hex names the
+                        # stored copy identically).
+                        other_head = ev.hex()
             self._timed("insert", t0)
 
             if len(unknown) > 0 or len(self.transaction_pool) > 0:
@@ -414,6 +443,22 @@ class Core:
 
     def to_wire(self, events: List[Event]) -> List[WireEvent]:
         return [e.to_wire() for e in events]
+
+    def to_wire_batch(self, events: List[Event], wire_format: str):
+        """Pack a diff for the wire in the requested format —
+        `ColumnarEvents` ("columnar") or the legacy `List[WireEvent]`
+        ("gojson") — stamped as the wire_pack phase. Event.to_wire is
+        memoized, so the legacy spelling and the column walk both read
+        cached wire forms in steady state."""
+        t0 = time.perf_counter_ns()
+        if wire_format == "columnar":
+            from ..net.columnar import ColumnarEvents
+
+            out = ColumnarEvents.from_events(events)
+        else:
+            out = [e.to_wire() for e in events]
+        self._timed("wire_pack", t0)
+        return out
 
     def run_consensus(self, unlocked=None) -> None:
         t0 = time.perf_counter_ns()

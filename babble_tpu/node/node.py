@@ -148,7 +148,11 @@ class Node:
             verify_workers=getattr(conf, "verify_workers", -1),
             trace=self.trace,
             registry=self.registry,
+            compile_cache_dir=getattr(conf, "compile_cache_dir", ""),
         )
+        # Preferred sync payload encoding (docs/ingest.md): what this
+        # node SENDS and SERVES; both wire forms are always accepted.
+        self._wire_format = getattr(conf, "wire_format", "columnar")
         self.core_lock = threading.Lock()
         # At most two gossip rounds in flight (see _babble).
         self._gossip_slots = threading.Semaphore(2)
@@ -650,8 +654,15 @@ class Node:
         self._m_sync_requests.inc()
         # Clock handshake (telemetry/clock.py): every pull doubles as
         # an NTP sample — t0 at send, the peer echoes its receive and
-        # reply stamps, t3 at response.
+        # reply stamps, t3 at response. The wire hint asks the peer for
+        # a columnar response payload (in-process transports deliver it
+        # as-is; the TCP transport overrides the hint with its own
+        # per-peer negotiation).
         req = SyncRequest(self.id, known, t_send=self.clock.epoch_ns())
+        if self._wire_format == "columnar":
+            from ..net.columnar import WIRE_VERSION
+
+            req.wire = WIRE_VERSION
         t0 = time.monotonic()
         try:
             resp = self.trans.sync(peer_addr, req)
@@ -681,7 +692,7 @@ class Node:
             if self.core.over_sync_limit(known, self.conf.sync_limit):
                 return
             diff = self.core.diff(known)
-            wire_events = self.core.to_wire(diff)
+        wire_events = self.core.to_wire_batch(diff, self._wire_format)
 
         self._m_sync_requests.inc()
         t0 = time.monotonic()
@@ -796,7 +807,16 @@ class Node:
             try:
                 with self.core_lock:
                     diff = self.core.diff(cmd.known)
-                resp.events = self.core.to_wire(diff)
+                # Serve the requested wire form when we speak it; the
+                # requester always accepts either, so a pinned-legacy
+                # node simply keeps serving Go-JSON event dicts.
+                from ..net.columnar import WIRE_VERSION
+
+                fmt = ("columnar"
+                       if (cmd.wire == WIRE_VERSION
+                           and self._wire_format == "columnar")
+                       else "gojson")
+                resp.events = self.core.to_wire_batch(diff, fmt)
                 self._flow_gossip_hop(resp.events, "serve", cmd.from_id)
             except Exception as exc:  # noqa: BLE001
                 resp_err = exc
@@ -813,9 +833,16 @@ class Node:
     def _flow_gossip_hop(self, wire_events, hop: str, peer) -> None:
         """Flow breadcrumbs for traced events leaving this node on a
         gossip leg (push or pull-serve): which peer, which batch. One
-        cheap attribute check per event when tracing is idle; spans +
-        flows only materialize when a traced event is in the batch."""
-        traced = [w.trace_id for w in wire_events if w.trace_id]
+        cheap check per batch when tracing is idle; spans + flows only
+        materialize when a traced event is in the batch. Accepts both
+        wire payload forms (the columnar batch keeps trace ids as an
+        optional sidecar column)."""
+        if isinstance(wire_events, list):
+            traced = [w.trace_id for w in wire_events if w.trace_id]
+        elif wire_events.trace_ids is not None:
+            traced = [t for t in wire_events.trace_ids.tolist() if t]
+        else:
+            traced = []
         if not traced:
             return
         with self.trace.span("gossip_" + hop, cat="gossip",

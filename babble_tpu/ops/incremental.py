@@ -865,10 +865,93 @@ class IncrementalEngine:
         self._empty_delta_ok = False
         return i
 
-    def append_batch(self, sp, op, creator, index, coin, ts_ns) -> None:
-        for k in range(len(sp)):
-            self.append(int(sp[k]), int(op[k]), int(creator[k]),
-                        int(index[k]), bool(coin[k]), int(ts_ns[k]))
+    def append_batch(self, sp, op, creator, index, coin, ts_ns) -> int:
+        """Vectorized append of a whole batch: one numpy slice
+        assignment per staging column instead of a Python `append` per
+        event — the device-direct ingest seam the columnar gossip wire
+        lands on (docs/ingest.md). Semantics identical to the serial
+        loop: per-creator contiguity and self-parent-is-head are
+        enforced for every row, including rows whose parent is earlier
+        in the same batch. Returns the first assigned event id (the
+        batch occupies ids [first, first + len)); raises ValueError
+        with NOTHING appended on an invalid batch — stricter than the
+        serial loop's valid-prefix insert, and the host-side parent
+        checks upstream (graph / tpu_graph) keep invalid rows from
+        reaching here."""
+        m = len(sp)
+        if m == 0:
+            return self.e
+        if m == 1:
+            return self.append(int(sp[0]), int(op[0]), int(creator[0]),
+                               int(index[0]), bool(coin[0]), int(ts_ns[0]))
+        sp = np.asarray(sp, np.int64)
+        op = np.asarray(op, np.int64)
+        cr = np.asarray(creator, np.int64)
+        idx = np.asarray(index, np.int64)
+        coin = np.asarray(coin)
+        ts = np.asarray(ts_ns, np.int64)
+
+        pos = idx - self.index_base[cr]
+        # Occurrence rank of each row within its creator group (stable):
+        # the j-th batch row of a creator must land at chain position
+        # chain_len[creator] + j, exactly like j serial appends.
+        order = np.argsort(cr, kind="stable")
+        scr = cr[order]
+        new_group = np.r_[True, scr[1:] != scr[:-1]]
+        group_start = np.flatnonzero(new_group)
+        group_sizes = np.diff(np.r_[group_start, m])
+        occ_sorted = np.arange(m) - np.repeat(group_start, group_sizes)
+        occ = np.empty(m, np.int64)
+        occ[order] = occ_sorted
+        expect_pos = self.chain_len[cr] + occ
+        if not np.array_equal(pos, expect_pos):
+            k = int(np.flatnonzero(pos != expect_pos)[0])
+            raise ValueError(
+                f"non-contiguous position {int(pos[k])} for creator "
+                f"{int(cr[k])} (expected {int(expect_pos[k])})")
+
+        # Grow BEFORE the head gather below (a chain position past the
+        # current bucket size would otherwise read out of bounds).
+        # Growing host mirrors is side-effect-free for a batch that
+        # then fails validation: capacity is not observable state.
+        while self.e + m > self.cap:
+            self._grow_capacity()
+        while int(pos.max()) >= self.kcap:
+            self._grow_chains()
+
+        # Self-parent must be the creator's head at that point: the
+        # stored chain tip for a creator's first batch row, the
+        # previous batch row's id (e0 + row) for later ones.
+        e0 = self.e
+        expect_sp = np.where(
+            pos > 0, self.chain[cr, np.maximum(pos, 1) - 1], -1)
+        prev_row = np.empty(m, np.int64)
+        prev_row[order] = np.r_[-1, order[:-1]]
+        in_batch = occ > 0
+        expect_sp[in_batch] = e0 + prev_row[in_batch]
+        if not np.array_equal(sp, expect_sp):
+            raise ValueError("self-parent is not the creator's head")
+
+        lo, hi = e0, e0 + m
+        self.self_parent[lo:hi] = sp
+        self.other_parent[lo:hi] = op
+        self.creator[lo:hi] = cr
+        self.index[lo:hi] = pos
+        self.coin[lo:hi] = np.where(coin, 1, 0)
+        self.root_base[lo:hi] = np.where(
+            (sp < 0) | (op < 0), self.root_round[cr] + 1, -1)
+        self.ts_ns[lo:hi] = ts
+        self.chain[cr, pos] = np.arange(lo, hi, dtype=np.int32)
+        np.add.at(self.chain_len, scr[new_group],
+                  group_sizes.astype(np.int32))
+        self.rounds[lo:hi] = -1
+        self.witness[lo:hi] = False
+        self.rr[lo:hi] = -1
+        self.cts_ns[lo:hi] = CTS_SENTINEL
+        self.e = hi
+        self._new_since_run.extend(range(lo, hi))
+        self._empty_delta_ok = False
+        return e0
 
     def _grow_capacity(self) -> None:
         new_cap = self.cap * 2

@@ -62,6 +62,8 @@ HEADLINES: Dict[str, str] = {
     "sustained_events_per_s": "throughput",
     "sustained_steady_events_per_s": "throughput",
     "node_events_per_s": "throughput",
+    "node_legacy_events_per_s": "throughput",
+    "wire_ingest_events_per_s": "throughput",
     "node_file_events_per_s": "throughput",
     "node_tpu_events_per_s": "throughput",
     "node16_events_per_s": "throughput",

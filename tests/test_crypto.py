@@ -80,3 +80,48 @@ def test_generate_pem_key():
     assert dump.public_key.startswith("0x")
     assert len(dump.public_key) == 2 + 130  # 65 bytes hex
     assert "EC PRIVATE KEY" in dump.private_key
+
+
+def test_openssl_ctypes_accelerator_parity():
+    """When the system libcrypto is loadable, the ctypes accelerator
+    must be bit-compatible with the pure-Python fallback: identical
+    RFC 6979 signatures, interchangeable verification, and honest
+    rejection of bad signatures and off-curve points."""
+    from babble_tpu.crypto import _fallback as fb
+    from babble_tpu.crypto import _openssl as ossl
+
+    if not ossl.available():
+        import pytest
+
+        pytest.skip("system libcrypto not loadable")
+
+    key = fb.key_from_seed(1234)
+    digest = crypto.sha256(b"accelerated")
+    r, s = ossl.sign(key.d, digest)
+    assert (r, s) == fb.sign(key, digest)  # bit-identical nonces
+    pub = fb.pub_key_bytes(key)
+    assert ossl.verify(pub, digest, r, s)
+    assert fb.verify(key.pub, digest, r, s)
+    assert not ossl.verify(pub, crypto.sha256(b"other"), r, s)
+    assert not ossl.verify(pub, digest, r, s + 1)
+    assert not ossl.verify(pub, digest, 0, s)
+    # off-curve point: rejected, not crashed
+    bad = b"\x04" + b"\x01" * 64
+    assert not ossl.verify(bad, digest, r, s)
+    # base-point multiplication agrees with the pure-Python ladder
+    for k in (1, 2, 0xDEADBEEF, fb.N - 1):
+        assert ossl.base_point_x(k) == fb._mult_base(k)[0]
+
+
+def test_pure_crypto_env_kill_switch(tmp_path):
+    """BABBLE_PURE_CRYPTO=1 must pin BACKEND to pure-python (CI's
+    no-optional-deps job relies on it to keep the fallback exercised)."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from babble_tpu import crypto; print(crypto.BACKEND)"],
+        capture_output=True, text=True,
+        env={**os.environ, "BABBLE_PURE_CRYPTO": "1"})
+    assert out.stdout.strip() == "pure-python", out.stderr

@@ -381,3 +381,81 @@ def test_tpu_reset_from_frame():
     assert h.last_consensus_round == 1
     _assert_consensus_parity(
         h, t, [e.hex() for e in hf.events], b.get_name)
+
+
+def test_append_batch_vectorized_matches_serial():
+    """The vectorized append_batch (one slice assignment per staging
+    column) must leave the engine bit-identical to per-event appends —
+    including interleaved-creator batches, capacity doubling, and
+    chain-bucket growth — and reject the same invalid batches."""
+    dag, _ = synthetic_dag(8, 400, seed=3)
+    ts = np.arange(400, dtype=np.int64) * 7 + 100
+    serial = IncrementalEngine(8, capacity=64, block=64, k_capacity=8)
+    batched = IncrementalEngine(8, capacity=64, block=64, k_capacity=8)
+    for k in range(400):
+        serial.append(int(dag.self_parent[k]), int(dag.other_parent[k]),
+                      int(dag.creator[k]), int(dag.index[k]),
+                      bool(dag.coin[k]), int(ts[k]))
+    lo = 0
+    for size in (1, 3, 17, 64, 5, 127, 400):
+        hi = min(400, lo + size)
+        first = batched.append_batch(
+            dag.self_parent[lo:hi], dag.other_parent[lo:hi],
+            dag.creator[lo:hi], dag.index[lo:hi], dag.coin[lo:hi],
+            ts[lo:hi])
+        assert first == lo
+        lo = hi
+    for name in ("self_parent", "other_parent", "creator", "index",
+                 "coin", "root_base", "ts_ns", "chain", "chain_len",
+                 "rounds", "witness", "rr", "cts_ns"):
+        assert np.array_equal(getattr(serial, name),
+                              getattr(batched, name)), name
+    assert serial.e == batched.e
+    assert serial._new_since_run == batched._new_since_run
+
+    with pytest.raises(ValueError):
+        batched.append_batch(
+            np.array([-1, 5]), np.array([-1, -1]), np.array([0, 0]),
+            np.array([999, 1000]), np.array([0, 0]), np.array([1, 2]))
+
+    serial.run()
+    batched.run()
+    assert np.array_equal(serial.rounds[:serial.e],
+                          batched.rounds[:batched.e])
+    assert np.array_equal(serial.rr[:serial.e], batched.rr[:batched.e])
+
+
+def test_tpu_insert_wire_batch_matches_serial_inserts():
+    """Device-direct ingest seam: TpuHashgraph.insert_wire_batch (host
+    checks per event, ONE vectorized engine append) must equal the
+    serial insert_event loop — engine state, store contents, and the
+    consensus it then decides."""
+    h, b = build_consensus_graph()
+    participants = b.participants()
+
+    serial = TpuHashgraph(participants, InmemStore(participants, CACHE),
+                          capacity=64, block=64)
+    batched = TpuHashgraph(participants, InmemStore(participants, CACHE),
+                           capacity=64, block=64)
+    evs = b.ordered_events
+    for ev in evs:
+        serial.insert_event(Event(ev.body, r=ev.r, s=ev.s), True)
+    # two chunks, split mid-stream, cloned events
+    mid = len(evs) // 2
+    batched.insert_wire_batch(
+        [Event(e.body, r=e.r, s=e.s) for e in evs[:mid]])
+    batched.insert_wire_batch(
+        [Event(e.body, r=e.r, s=e.s) for e in evs[mid:]])
+
+    assert serial.known() == batched.known()
+    assert serial.undetermined_events == batched.undetermined_events
+    eng_s, eng_b = serial.engine, batched.engine
+    for name in ("self_parent", "other_parent", "creator", "index",
+                 "coin", "ts_ns", "chain", "chain_len"):
+        assert np.array_equal(getattr(eng_s, name),
+                              getattr(eng_b, name)), name
+    serial.run_consensus()
+    batched.run_consensus()
+    assert serial.store.consensus_events() == \
+        batched.store.consensus_events()
+    assert serial.last_consensus_round == batched.last_consensus_round

@@ -105,7 +105,11 @@ def test_sync_round_trip(kind):
         assert out.sync_limit is False
         assert out.known == {0: 4, 1: 5, 2: 6}
         assert len(out.events) == 1
-        we = out.events[0]
+        # The TCP pair negotiates the columnar wire, so the payload
+        # arrives as a packed batch; the legacy view is equivalent.
+        events = (out.events if isinstance(out.events, list)
+                  else out.events.to_wire_events())
+        we = events[0]
         assert we.body.self_parent_index == 1
         assert we.body.other_parent_creator_id == 10
         assert we.body.creator_id == 9

@@ -1,0 +1,374 @@
+"""Columnar gossip wire format (net/columnar.py, docs/ingest.md).
+
+Covers the tentpole's correctness contract end to end:
+
+- codec round trip (nil/empty/loaded tx slices, trace-id sidecar,
+  full-width R/S scalars) and frame validation;
+- the fast Go-JSON materializer is byte-identical to the GoStruct
+  encoder (the property that keeps hashes/signatures stable);
+- `read_wire_batch` produces the same events from either wire form;
+- TCP negotiation: columnar<->columnar moves binary frames,
+  columnar->legacy transparently falls back, message-size caps bound
+  both framings;
+- mixed-format interop: a DETERMINISTIC 3-core gossip script run
+  all-legacy, all-columnar, and mixed commits byte-identical blocks,
+  trace sidecar included.
+"""
+
+import json
+import queue
+import threading
+
+import pytest
+
+import babble_tpu.gojson as gojson
+from babble_tpu import crypto
+from babble_tpu.gojson import Timestamp
+from babble_tpu.hashgraph.event import (
+    Event,
+    WireBody,
+    WireEvent,
+    materialize_wire_event,
+)
+from babble_tpu.hashgraph.inmem_store import InmemStore
+from babble_tpu.net.columnar import (
+    ColumnarEvents,
+    WIRE_VERSION,
+    WireFormatError,
+)
+from babble_tpu.net.tcp_transport import TCPTransport
+from babble_tpu.net.transport import (
+    EagerSyncRequest,
+    EagerSyncResponse,
+    SyncRequest,
+    SyncResponse,
+    TransportError,
+)
+from babble_tpu.node.core import Core
+
+N_ORDER = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+
+
+def wire_event(txs=None, idx=1, cid=0, trace_id=0, r=12345, s=67890):
+    return WireEvent(
+        WireBody(
+            transactions=txs,
+            self_parent_index=idx - 1,
+            other_parent_creator_id=(cid + 1) % 3,
+            other_parent_index=0,
+            creator_id=cid,
+            timestamp=Timestamp(1_700_000_000_000_000_123 + idx),
+            index=idx,
+        ),
+        r=r, s=s, trace_id=trace_id,
+    )
+
+
+# -- codec ---------------------------------------------------------------
+
+
+def test_codec_round_trip_preserves_wire_dicts():
+    wires = [
+        wire_event(None, idx=0),
+        wire_event([], idx=1),
+        wire_event([b"a", b"\x00\xff" * 10, b""], idx=2, trace_id=77),
+        wire_event([b"solo"], idx=3, cid=2, r=N_ORDER - 1, s=N_ORDER - 2),
+    ]
+    cols = ColumnarEvents.from_wire_events(wires)
+    back = ColumnarEvents.decode(cols.encode()).to_wire_events()
+    assert len(back) == len(wires)
+    for orig, got in zip(wires, back):
+        assert got.to_dict() == orig.to_dict()
+        assert got.trace_id == orig.trace_id
+
+
+def test_codec_trace_column_absent_when_untraced():
+    cols = ColumnarEvents.from_wire_events([wire_event(), wire_event(idx=2)])
+    assert cols.trace_ids is None
+    # and the frame does not grow a trace column
+    n_untraced = len(cols.encode())
+    traced = ColumnarEvents.from_wire_events(
+        [wire_event(trace_id=5), wire_event(idx=2)])
+    assert len(traced.encode()) == n_untraced + 2 * 8
+
+
+def test_codec_rejects_malformed_frames():
+    cols = ColumnarEvents.from_wire_events([wire_event([b"tx"])])
+    buf = cols.encode()
+    with pytest.raises(WireFormatError):
+        ColumnarEvents.decode(b"XXXX" + buf[4:])
+    with pytest.raises(WireFormatError):
+        ColumnarEvents.decode(buf[:-1])  # truncated
+    with pytest.raises(WireFormatError):
+        ColumnarEvents.decode(buf + b"\x00")  # trailing junk
+
+
+# -- fast materializer ---------------------------------------------------
+
+
+@pytest.mark.parametrize("txs,parents", [
+    (None, ["", ""]),
+    ([], ["0xAA", ""]),
+    ([b"hello", b"\x00\xfe\xff"], ["0xAA", "0xBB"]),
+])
+def test_materializer_matches_gostruct_encoder(txs, parents):
+    key = crypto.key_from_seed(42)
+    pub = crypto.pub_key_bytes(key)
+    ev = Event.new(txs, parents, pub, 3,
+                   timestamp=Timestamp(1_723_400_000_123_456_789))
+    ev.sign(key)
+    ev.set_wire_info(2, 1, 7, 0)
+
+    m = materialize_wire_event(
+        pub, parents[0], parents[1], 3, ev.body.timestamp.ns, txs,
+        int(ev.r), int(ev.s), 2, 1, 7, 0)
+    # seeded memos match the walked encoder...
+    assert m.body.marshal_value() == ev.body.marshal_value()
+    assert m.marshal() == ev.marshal()
+    assert m.hex() == ev.hex()
+    assert m.verify()
+    # ...and a from-scratch re-encode (memos dropped) agrees, so the
+    # template and the GoStruct walker are the same function.
+    m.invalidate()
+    assert m.marshal() == ev.marshal()
+
+
+# -- read path parity ----------------------------------------------------
+
+
+def _three_cores(seed_base=7000):
+    keys = sorted((crypto.key_from_seed(seed_base + i) for i in range(3)),
+                  key=lambda k: crypto.pub_key_bytes(k).hex().upper())
+    parts = {"0x" + crypto.pub_key_bytes(k).hex().upper(): i
+             for i, k in enumerate(keys)}
+    cores = [Core(i, k, parts, InmemStore(parts, 10000))
+             for i, k in enumerate(keys)]
+    for c in cores:
+        c.init()
+    return keys, parts, cores
+
+
+def test_read_wire_batch_columnar_matches_legacy():
+    _, parts, cores = _three_cores()
+    a, b = cores[0], cores[1]
+    diff = b.diff(a.known())
+    legacy = a.hg.read_wire_batch([e.to_wire() for e in diff])
+    cols = ColumnarEvents.from_events(diff)
+    columnar = a.hg.read_wire_batch(ColumnarEvents.decode(cols.encode()))
+    assert [e.hex() for e in legacy] == [e.hex() for e in columnar]
+    for el, ec in zip(legacy, columnar):
+        assert el.marshal() == ec.marshal()
+        assert el.body.parents == ec.body.parents
+        assert ec.verify()
+
+
+# -- deterministic mixed-format interop ---------------------------------
+
+
+def _scripted_cluster(monkeypatch, wire_formats, trace=False):
+    """Run a fixed gossip script over three Cores, each packing its
+    outbound diffs in its own wire format, with deterministic
+    timestamps — returns each node's committed blocks as Go-JSON
+    bytes. Any two runs of this function must agree byte-for-byte
+    regardless of the wire-format mix (the interop contract)."""
+    tick = {"ns": 1_700_000_000_000_000_000}
+
+    def fake_now():
+        tick["ns"] += 1_000_000
+        return Timestamp(tick["ns"])
+
+    monkeypatch.setattr(gojson.Timestamp, "now", staticmethod(fake_now))
+
+    keys, parts, cores = _three_cores()
+    blocks = [[] for _ in cores]
+    for i, c in enumerate(cores):
+        c._commit_callback = blocks[i].append
+        c.hg.commit_callback = blocks[i].append
+
+    def hop(dst, src, txn=None):
+        diff = cores[src].diff(cores[dst].known())
+        payload = cores[src].to_wire_batch(diff, wire_formats[src])
+        if txn is not None:
+            tid = {txn: 1 << 40} if trace else None
+            cores[dst].add_transactions([txn], trace_ids=tid)
+        cores[dst].sync(payload)
+        cores[dst].run_consensus()
+
+    # fixed script: enough rounds for several blocks to commit
+    script = [(0, 1), (1, 2), (2, 0), (1, 0), (0, 2), (2, 1)] * 12
+    for i, (dst, src) in enumerate(script):
+        hop(dst, src, b"tx %d" % i)
+
+    out = []
+    for blist in blocks:
+        out.append([
+            json.dumps({"r": b.round_received,
+                        "txs": [t.hex() for t in (b.transactions or [])]},
+                       sort_keys=True)
+            for b in blist
+        ])
+    return out
+
+
+def test_mixed_cluster_commits_byte_identical_blocks(monkeypatch):
+    runs = {}
+    for name, fmts in [
+        ("legacy", ["gojson"] * 3),
+        ("columnar", ["columnar"] * 3),
+        ("mixed", ["columnar", "gojson", "columnar"]),
+        ("mixed_traced", ["columnar", "gojson", "columnar"]),
+    ]:
+        runs[name] = _scripted_cluster(
+            monkeypatch, fmts, trace=(name == "mixed_traced"))
+        # within a run: every node commits the same block sequence up
+        # to the in-flight tail (the script ends mid-gossip, so nodes
+        # may trail by a pass — byte-identical on the common prefix)
+        a, b, c = runs[name]
+        m = min(len(a), len(b), len(c))
+        assert m > 0, name
+        assert a[:m] == b[:m] == c[:m], name
+    # across runs: wire format (and the trace sidecar) never leaks
+    # into consensus output — the deterministic script makes whole
+    # runs comparable byte-for-byte
+    assert runs["legacy"] == runs["columnar"] == runs["mixed"] \
+        == runs["mixed_traced"]
+
+
+def test_trace_sidecar_rides_columnar_wire_and_gojson_roundtrip():
+    _, parts, cores = _three_cores()
+    a, b = cores[0], cores[1]
+    # stamp a traced tx into b's next self-event
+    b.add_transactions([b"traced"], trace_ids={b"traced": 424242})
+    b.sync(a.to_wire_batch(a.diff(b.known()), "columnar"))
+    diff = b.diff(a.known())
+    assert any(e.trace_id == 424242 for e in diff)
+    cols = ColumnarEvents.decode(
+        ColumnarEvents.from_events(diff).encode())
+    got = a.hg.read_wire_batch(cols)
+    assert any(e.trace_id == 424242 for e in got)
+    # gojson round trip preserves the sidecar and the signed bytes
+    for w in cols.to_wire_events():
+        w2 = WireEvent.from_json_obj(json.loads(
+            json.dumps(w.to_dict(), default=_b64)))
+        assert w2.to_dict() == w.to_dict()
+        assert w2.trace_id == w.trace_id
+
+
+def _b64(obj):
+    import base64
+
+    if isinstance(obj, (bytes, bytearray)):
+        return base64.b64encode(bytes(obj)).decode()
+    raise TypeError
+
+
+# -- TCP negotiation + framing ------------------------------------------
+
+
+def _tcp_pair(fmt1="columnar", fmt2="columnar", **kw):
+    t1 = TCPTransport("127.0.0.1:0", timeout=2.0, wire_format=fmt1, **kw)
+    t2 = TCPTransport("127.0.0.1:0", timeout=2.0, wire_format=fmt2, **kw)
+    return t1, t2
+
+
+def _serve_sync(trans, resp, n=1):
+    def loop():
+        for _ in range(n):
+            try:
+                rpc = trans.consumer().get(timeout=5.0)
+            except queue.Empty:
+                return
+            rpc.respond(resp, None)
+
+    threading.Thread(target=loop, daemon=True).start()
+
+
+def test_tcp_columnar_negotiation_moves_binary_frames():
+    t1, t2 = _tcp_pair()
+    try:
+        resp = SyncResponse(1, events=[wire_event([b"tx"])],
+                            known={0: 4})
+        _serve_sync(t1, resp)
+        out = t2.sync(t1.local_addr(), SyncRequest(0, {0: 1}))
+        assert t2._peer_columnar[t1.local_addr()] is True
+        assert isinstance(out.events, ColumnarEvents)
+        assert out.known == {0: 4}
+        got = out.events.to_wire_events()
+        assert got[0].to_dict() == wire_event([b"tx"]).to_dict()
+        # byte accounting: the payload moved as columnar frames
+        rx = t2._byte_counters[("columnar", "rx")].value
+        assert rx > 0
+    finally:
+        t1.close()
+        t2.close()
+
+
+def test_tcp_columnar_to_legacy_falls_back_transparently():
+    t1, t2 = _tcp_pair(fmt1="gojson", fmt2="columnar")
+    try:
+        resp = SyncResponse(1, events=[wire_event([b"tx"])])
+        _serve_sync(t1, resp)
+        out = t2.sync(t1.local_addr(), SyncRequest(0, {0: 1}))
+        # hello negotiated DOWN: the peer answered gojson
+        assert t2._peer_columnar[t1.local_addr()] is False
+        assert isinstance(out.events, list)
+        assert out.events[0].to_dict() == wire_event([b"tx"]).to_dict()
+
+        # and a columnar payload pushed AT the legacy peer downconverts
+        _serve_sync(t1, EagerSyncResponse(1, True))
+        cols = ColumnarEvents.from_wire_events([wire_event([b"p"], idx=2)])
+        got = t2.eager_sync(t1.local_addr(), EagerSyncRequest(0, cols))
+        assert got.success is True
+    finally:
+        t1.close()
+        t2.close()
+
+
+def test_tcp_eager_columnar_round_trip():
+    t1, t2 = _tcp_pair()
+    got_events = {}
+    try:
+        def loop():
+            rpc = t1.consumer().get(timeout=5.0)
+            got_events["events"] = rpc.command.events
+            rpc.respond(EagerSyncResponse(1, True), None)
+
+        threading.Thread(target=loop, daemon=True).start()
+        cols = ColumnarEvents.from_wire_events(
+            [wire_event([b"payload"], trace_id=9)])
+        out = t2.eager_sync(t1.local_addr(), EagerSyncRequest(0, cols))
+        assert out.success is True
+        arrived = got_events["events"]
+        assert isinstance(arrived, ColumnarEvents)
+        assert arrived.to_wire_events()[0].trace_id == 9
+    finally:
+        t1.close()
+        t2.close()
+
+
+def test_tcp_message_size_cap_is_enforced():
+    t1, t2 = _tcp_pair(max_msg_bytes=512)
+    try:
+        # Oversized legacy JSON line: the request body itself blows the
+        # sender-side cap? No — caps bind on RECEIVE; build a payload
+        # the responder cannot frame under 512 bytes.
+        resp = SyncResponse(
+            1, events=[wire_event([b"x" * 2048])])
+        _serve_sync(t1, resp, n=2)
+        with pytest.raises(TransportError):
+            t2.sync(t1.local_addr(), SyncRequest(0, {0: 1}))
+    finally:
+        t1.close()
+        t2.close()
+
+
+def test_tcp_legacy_json_line_cap():
+    t1, t2 = _tcp_pair(fmt1="gojson", fmt2="gojson", max_msg_bytes=256)
+    try:
+        resp = SyncResponse(1, events=[wire_event([b"y" * 1024])])
+        _serve_sync(t1, resp)
+        with pytest.raises(TransportError):
+            t2.sync(t1.local_addr(), SyncRequest(0, {0: 1}))
+    finally:
+        t1.close()
+        t2.close()
