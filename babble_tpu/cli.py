@@ -118,6 +118,8 @@ def cmd_run(args) -> int:
             0 if args.no_failover else args.engine_failover_threshold),
         trace_ring=args.trace_ring,
         trace_sample=args.trace_sample,
+        divergence_sentinel=not args.no_sentinel,
+        stall_timeout=args.stall_timeout / 1000.0,
         wire_format=args.wire_format,
         max_msg_bytes=args.max_msg_bytes << 20,
         compile_cache_dir=args.compile_cache_dir,
@@ -242,6 +244,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "babble_tpu.telemetry.tracemerge. 0 disables "
                          "(no per-tx overhead); 0.001 is the "
                          "documented 'on' rate")
+    rn.add_argument("--no_sentinel", action="store_true",
+                    help="disable the divergence sentinel (the rolling "
+                         "committed-block chain hash piggybacked on "
+                         "gossip and compared against peers — "
+                         "docs/observability.md 'Consensus health')")
+    rn.add_argument("--stall_timeout", type=int, default=30000,
+                    help="milliseconds without a decided round (while "
+                         "payload events are pending) before the stall "
+                         "watchdog emits a diagnosis naming the stuck "
+                         "round, its undecided witnesses, and the "
+                         "silent creators; 0 disables")
     rn.add_argument("--heartbeat", type=int, default=1000,
                     help="heartbeat timer in milliseconds")
     rn.add_argument("--max_pool", type=int, default=2,

@@ -6,7 +6,8 @@ from .round_info import RoundInfo, RoundEvent, Trilean
 from .store import Store
 from .inmem_store import InmemStore
 from .file_store import FileStore
-from .graph import Hashgraph
+from .graph import ForkError, Hashgraph, InsertError
+from .health import BlockHashChain
 from .participant_events import ParticipantEventsCache
 
 __all__ = [
@@ -25,6 +26,9 @@ __all__ = [
     "Store",
     "InmemStore",
     "FileStore",
+    "BlockHashChain",
+    "ForkError",
     "Hashgraph",
+    "InsertError",
     "ParticipantEventsCache",
 ]

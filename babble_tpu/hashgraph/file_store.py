@@ -213,6 +213,12 @@ class FileStore:
                     pubkey TEXT PRIMARY KEY, data TEXT NOT NULL);
                 CREATE TABLE IF NOT EXISTS meta (
                     key TEXT PRIMARY KEY, value TEXT NOT NULL);
+                CREATE TABLE IF NOT EXISTS forks (
+                    creator TEXT NOT NULL,
+                    idx INTEGER NOT NULL,
+                    forged TEXT NOT NULL,
+                    data TEXT NOT NULL,
+                    PRIMARY KEY (creator, idx, forged));
                 """
             )
             # Schema-v1 migration: the events table predates the
@@ -297,6 +303,53 @@ class FileStore:
                 return
             self._set_meta("last_committed_block", str(rr))
             self._commit()
+
+    # -- consensus health (docs/observability.md "Consensus health") ------
+
+    def add_fork_evidence(self, record: dict) -> bool:
+        """Equivocation proof, deduped on (creator, idx, forged hash).
+        Joins an open batch (the insert that detected the fork runs
+        inside a sync batch, whose commit makes the evidence durable
+        even though the forged event itself is rejected). Survives
+        reset() — evidence is forensic, not consensus state."""
+        with self._lock:
+            if self._closed:
+                return False
+            cur = self._db.execute(
+                "INSERT OR IGNORE INTO forks VALUES (?, ?, ?, ?)",
+                (record["creator"], record["index"], record["forged"],
+                 json.dumps(record)),
+            )
+            fresh = cur.rowcount > 0
+            if fresh:
+                self._commit()
+        return fresh
+
+    def fork_evidence(self) -> List[dict]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT data FROM forks ORDER BY creator, idx").fetchall()
+        return [json.loads(r[0]) for r in rows]
+
+    def chain_state(self) -> Optional[dict]:
+        """Persisted divergence-sentinel chain state (node/health.py),
+        or None when never written. Stored next to the delivered-block
+        anchor so the two advance atomically: a restarted node resumes
+        its chain segment exactly where redelivery resumes blocks."""
+        with self._lock:
+            row = self._db.execute(
+                "SELECT value FROM meta WHERE key = 'chain_state'"
+            ).fetchone()
+        return json.loads(row[0]) if row is not None else None
+
+    def set_chain_state(self, state: dict) -> None:
+        """Meta write WITHOUT a forced commit: the caller pairs this
+        with set_last_committed_block (which commits), so the chain
+        link and the anchor it corresponds to are durable together."""
+        with self._lock:
+            if self._closed:
+                return
+            self._set_meta("chain_state", json.dumps(state))
 
     # -- batch / transaction protocol --------------------------------------
 

@@ -39,6 +39,14 @@ class InsertError(Exception):
     pass
 
 
+class ForkError(InsertError):
+    """Equivocation: a SIGNED event by a creator at an index where a
+    different signed event already exists. Unlike a generic
+    InsertError (stale parent, unknown coordinates), this is proof of
+    Byzantine behavior — the evidence is recorded in the store before
+    the raise (docs/observability.md "Consensus health")."""
+
+
 class ParentRoundInfo:
     __slots__ = ("round", "is_root")
 
@@ -69,6 +77,10 @@ class Hashgraph:
 
         self.undetermined_events: List[str] = []
         self.undecided_rounds: List[int] = [0]
+        # Fork observer (node/core.py wires the babble_forks_total
+        # counter here): called with each NEW equivocation evidence
+        # record the insert path detects and persists.
+        self.fork_observer: Optional[Callable[[dict], None]] = None
         self.last_consensus_round: Optional[int] = None
         self.last_commited_round_events = 0
         self.consensus_transactions = 0
@@ -299,6 +311,8 @@ class Hashgraph:
 
         try:
             self._check_self_parent(event)
+        except ForkError:
+            raise
         except Exception as e:
             raise InsertError(f"CheckSelfParent: {e}") from e
         try:
@@ -322,14 +336,49 @@ class Hashgraph:
 
     def _check_self_parent(self, event: Event) -> None:
         """Self-parent must be the creator's last known event — forbids forks
-        at insert time (hashgraph.go:404-420)."""
+        at insert time (hashgraph.go:404-420). Before rejecting, probe
+        whether the rejection IS a fork: a different signed event by
+        the same creator at the same index is equivocation, and the
+        proof (both events) is persisted as fork evidence rather than
+        discarded with a generic error."""
         creator_last_known, _ = self.store.last_from(event.creator())
         if event.self_parent() != creator_last_known:
+            self._maybe_record_fork(event)
             raise InsertError(
                 "Self-parent not last known event by creator "
                 f"(creator={event.creator()[:12]} idx={event.index()} "
                 f"self_parent={event.self_parent()[:12]} "
                 f"last_known={creator_last_known[:12]})")
+
+    def _maybe_record_fork(self, event: Event) -> None:
+        """Detect equivocation on the insert reject path: if the store
+        already holds a DIFFERENT event by this creator at this index
+        and the new event's signature verifies, that pair is
+        cryptographic proof of a fork. Evidence is deduped and
+        persisted by the store (surviving restarts on FileStore) and
+        surfaced through the fork observer as
+        `babble_forks_total{creator}`. Raises ForkError; returns
+        silently when the rejection is benign (stale parent, index
+        outside the window, unverifiable signature)."""
+        from .health import fork_evidence_record
+
+        try:
+            existing = self.store.participant_event(
+                event.creator(), event.index())
+        except StoreError:
+            return  # index unknown or aged out: not provably a fork
+        if existing == event.hex():
+            return  # idempotent duplicate, not a fork
+        if not event.verify():
+            return  # unsigned garbage proves nothing about the creator
+        record = fork_evidence_record(existing, event)
+        fresh = self.store.add_fork_evidence(record)
+        if fresh and self.fork_observer is not None:
+            self.fork_observer(record)
+        raise ForkError(
+            f"equivocation by {event.creator()[:12]} at index "
+            f"{event.index()}: {existing[:12]} vs {event.hex()[:12]} "
+            "(evidence recorded)")
 
     def _check_other_parent(self, event: Event) -> None:
         other_parent = event.other_parent()
@@ -840,6 +889,117 @@ class Hashgraph:
 
     def known(self) -> Dict[int, int]:
         return self.store.known()
+
+    # -- consensus health queries (docs/observability.md) ------------------
+    #
+    # Read-only views over store state (round rows, events) that the
+    # scrape/debug paths call WITHOUT the core lock — everything below
+    # snapshots dicts with list() and tolerates missing rows, exactly
+    # like get_stats' phase reads. Both engines serve these: the device
+    # engine mirrors its round rows and fame updates into the Store.
+
+    def undecided_witness_count(self) -> int:
+        """Witnesses across the pending rounds whose fame is still
+        undefined — the live size of the virtual-voting frontier."""
+        from .round_info import Trilean
+
+        n = 0
+        for r in list(self.undecided_rounds):
+            try:
+                ri = self.store.get_round(r)
+            except StoreError:
+                continue
+            n += sum(1 for e in list(ri.events.values())
+                     if e.witness and e.famous == Trilean.UNDEFINED)
+        return n
+
+    def last_decided_fame_round(self) -> int:
+        """Highest round with at least one fame-decided witness (-1
+        when none): tracks partial progress ABOVE last_consensus_round,
+        which only advances when a round decides completely."""
+        from .round_info import Trilean
+
+        floor = (self.last_consensus_round
+                 if self.last_consensus_round is not None else -1)
+        for r in range(self.store.last_round(), floor, -1):
+            try:
+                ri = self.store.get_round(r)
+            except StoreError:
+                continue
+            if any(e.witness and e.famous != Trilean.UNDEFINED
+                   for e in list(ri.events.values())):
+                return r
+        return floor
+
+    def dag_window(self, from_round: Optional[int] = None,
+                   max_rounds: int = 8,
+                   max_events: int = 4096) -> Dict:
+        """Bounded JSON export of the event DAG for /debug/hashgraph
+        and the dagdump renderer: events of rounds [from_round,
+        last_round] (default: the trailing `max_rounds`) plus any
+        still-undivided undetermined events, each with its parent
+        edges and round/witness/fame/received annotations."""
+        from .round_info import Trilean
+
+        last = self.store.last_round()
+        if from_round is None:
+            lo = max(0, last - max_rounds + 1)
+        else:
+            lo = max(0, int(from_round))
+        fame_name = {Trilean.UNDEFINED: None, Trilean.TRUE: True,
+                     Trilean.FALSE: False}
+        rows: Dict[str, Dict] = {}
+        truncated = False
+        for r in range(lo, last + 1):
+            try:
+                ri = self.store.get_round(r)
+            except StoreError:
+                continue
+            for x, re_ in list(ri.events.items()):
+                if len(rows) >= max_events:
+                    truncated = True
+                    break
+                rows[x] = {"round": r, "witness": re_.witness,
+                           "famous": fame_name.get(re_.famous)}
+        for x in list(self.undetermined_events):
+            if x in rows:
+                continue
+            if len(rows) >= max_events:
+                truncated = True
+                break
+            # Not yet divided into a round row: annotations unknown
+            # without forcing a consensus computation on this thread.
+            rows[x] = {"round": None, "witness": False, "famous": None}
+        events = []
+        for x, ann in rows.items():
+            try:
+                ev = self.store.get_event(x)
+            except StoreError:
+                continue  # aged out of the LRU window
+            events.append({
+                "hash": x,
+                "creator_id": self.participants.get(ev.creator(), -1),
+                "creator": ev.creator()[:18],
+                "index": ev.index(),
+                "self_parent": ev.self_parent(),
+                "other_parent": ev.other_parent(),
+                "round": ann["round"],
+                "witness": ann["witness"],
+                "famous": ann["famous"],
+                "round_received": ev.round_received,
+                "txs": len(ev.transactions() or []),
+                "topo": ev.topological_index,
+            })
+        events.sort(key=lambda e: e["topo"])
+        return {
+            "from_round": lo,
+            "to_round": last,
+            "last_consensus_round": self.last_consensus_round,
+            "participants": {pk: pid
+                             for pk, pid in self.participants.items()},
+            "events": events,
+            "truncated": truncated,
+        }
 
     # -- checkpoint / recovery --------------------------------------------
 

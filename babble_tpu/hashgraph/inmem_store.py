@@ -40,6 +40,11 @@ class InmemStore:
         self.roots: Dict[str, Root] = {pk: new_base_root() for pk in participants}
         self._last_round = -1
         self._last_committed_block = -1
+        # Equivocation evidence (hashgraph/health.py): forensic
+        # records, deduped, deliberately NOT cleared by reset() — a
+        # fork proof must survive a fast-forward.
+        self._fork_evidence: List[dict] = []
+        self._fork_keys: set = set()
 
     def cache_size(self) -> int:
         return self._cache_size
@@ -218,6 +223,19 @@ class InmemStore:
     def set_last_committed_block(self, rr: int) -> None:
         if rr > self._last_committed_block:
             self._last_committed_block = rr
+
+    def add_fork_evidence(self, record: dict) -> bool:
+        from .health import fork_evidence_key
+
+        key = fork_evidence_key(record)
+        if key in self._fork_keys:
+            return False
+        self._fork_keys.add(key)
+        self._fork_evidence.append(record)
+        return True
+
+    def fork_evidence(self) -> List[dict]:
+        return list(self._fork_evidence)
 
     def close(self) -> None:
         pass

@@ -105,4 +105,14 @@ class Store(Protocol):
 
     def set_last_committed_block(self, rr: int) -> None: ...
 
+    def add_fork_evidence(self, record: dict) -> bool:
+        """Persist one equivocation evidence record (two signed events
+        by one creator at one index — hashgraph/health.py). Deduped on
+        (creator, index, forged-hash); returns True when the record is
+        new. Durable stores keep evidence across restarts and resets —
+        it is forensic state, not consensus state."""
+        ...
+
+    def fork_evidence(self) -> List[dict]: ...
+
     def close(self) -> None: ...

@@ -31,7 +31,7 @@ from ..gojson import Timestamp, ZERO_TIME
 from ..ops.incremental import IncrementalEngine, RunDelta, ZERO_TIME_NS
 from .block import Block
 from .event import Event
-from .graph import Hashgraph, InsertError, middle_bit
+from .graph import ForkError, Hashgraph, InsertError, middle_bit
 from .root import Root
 from .round_info import RoundInfo
 from .store import Store
@@ -81,6 +81,8 @@ class TpuHashgraph(Hashgraph):
             raise InsertError("Invalid signature")
         try:
             self._check_self_parent(event)
+        except ForkError:
+            raise
         except Exception as e:
             raise InsertError(f"CheckSelfParent: {e}") from e
         try:
@@ -137,6 +139,8 @@ class TpuHashgraph(Hashgraph):
                     raise InsertError("Invalid signature")
                 try:
                     self._check_self_parent(ev)
+                except ForkError:
+                    raise
                 except Exception as e:
                     raise InsertError(f"CheckSelfParent: {e}") from e
                 try:

@@ -92,7 +92,13 @@ class FaultyTransport:
         # actually injected (docs/observability.md).
         self.injected = {"drop": 0, "delay": 0, "duplicate": 0,
                          "partitioned": 0, "crashed": 0,
-                         "inbound_crashed": 0}
+                         "inbound_crashed": 0, "equivocate": 0}
+        # Byzantine equivocation injector (docs/observability.md
+        # "Consensus health"): queued forged wire events delivered as
+        # an extra eager-sync push, proving fork detection fires
+        # within one gossip round. Tests build the conflicting signed
+        # events (they hold the keys); the transport only delivers.
+        self._equivocations: list = []
         from ..telemetry import get_registry
 
         _reg = get_registry()
@@ -143,6 +149,17 @@ class FaultyTransport:
 
     def restore(self) -> None:
         self._crashed.clear()
+
+    def inject_equivocation(self, wire_events, target: str = "") -> None:
+        """Queue one forged push: `wire_events` (signed, conflicting
+        WireEvents built by the test) are delivered as an extra
+        EagerSyncRequest to `target` — or to whichever peer the next
+        outbound push goes to, when no target is given. The genuine
+        payload is delivered unmodified first, so the honest DAG is
+        unaffected; the receiver's insert path must reject the forged
+        copy and record fork evidence."""
+        with self._lock:
+            self._equivocations.append((target, list(wire_events)))
 
     # -- fault application --------------------------------------------------
 
@@ -205,7 +222,28 @@ class FaultyTransport:
                 self._inner.eager_sync(target, args)
             except TransportError:
                 pass
+        self._maybe_equivocate(target, args.from_id)
         return resp
+
+    def _maybe_equivocate(self, target: str, from_id: int) -> None:
+        """Deliver any queued forged payload destined for `target` as
+        its own push. The receiver is expected to REJECT it (fork
+        evidence + error response), so the error is swallowed — a
+        Byzantine sender would not care either."""
+        with self._lock:
+            picked = None
+            for i, (tgt, events) in enumerate(self._equivocations):
+                if not tgt or tgt == target:
+                    picked = self._equivocations.pop(i)[1]
+                    break
+        if picked is None:
+            return
+        self._inject("equivocate")
+        try:
+            self._inner.eager_sync(
+                target, EagerSyncRequest(from_id, picked))
+        except TransportError:
+            pass
 
     def fast_forward(self, target: str,
                      args: FastForwardRequest) -> FastForwardResponse:

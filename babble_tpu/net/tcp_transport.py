@@ -153,6 +153,8 @@ def _pack_sync_response(resp: SyncResponse) -> bytes:
         header["ClockOrigin"] = resp.t_origin
         header["ClockRecv"] = resp.t_recv
         header["ClockReply"] = resp.t_reply
+    if resp.health is not None:
+        header["Health"] = resp.health
     hb = json.dumps(header).encode()
     return struct.pack(">I", len(hb)) + hb + events.encode()
 
@@ -169,6 +171,7 @@ def _unpack_sync_response(buf: bytes) -> SyncResponse:
         t_origin=header.get("ClockOrigin", 0),
         t_recv=header.get("ClockRecv", 0),
         t_reply=header.get("ClockReply", 0),
+        health=header.get("Health"),
     )
     resp.events = ColumnarEvents.decode(buf[4 + hlen:])
     return resp

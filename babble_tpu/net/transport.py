@@ -36,6 +36,12 @@ class SyncRequest:
     # the legacy wire bytes are unchanged and legacy decoders ignore
     # the extra key.
     wire: str = ""
+    # Consensus health sidecar (docs/observability.md "Consensus
+    # health"): the requester's committed-block chain claim + last
+    # consensus round (node/health.py). Same contract as the clock
+    # stamps: rides the dict only when set, never enters any signed
+    # event body, and a legacy peer ignores the extra key.
+    health: Optional[dict] = None
 
     def to_dict(self) -> dict:
         d = {"FromID": self.from_id,
@@ -44,6 +50,8 @@ class SyncRequest:
             d["ClockSend"] = self.t_send
         if self.wire:
             d["Wire"] = self.wire
+        if self.health is not None:
+            d["Health"] = self.health
         return d
 
     @classmethod
@@ -53,6 +61,7 @@ class SyncRequest:
             known={int(k): v for k, v in (d.get("Known") or {}).items()},
             t_send=d.get("ClockSend", 0),
             wire=d.get("Wire", ""),
+            health=d.get("Health"),
         )
 
 
@@ -73,6 +82,8 @@ class SyncResponse:
     t_origin: int = 0
     t_recv: int = 0
     t_reply: int = 0
+    # Responder's consensus health sidecar — see SyncRequest.health.
+    health: Optional[dict] = None
 
     def to_dict(self) -> dict:
         events = self.events
@@ -88,6 +99,8 @@ class SyncResponse:
             d["ClockOrigin"] = self.t_origin
             d["ClockRecv"] = self.t_recv
             d["ClockReply"] = self.t_reply
+        if self.health is not None:
+            d["Health"] = self.health
         return d
 
     @classmethod
@@ -100,6 +113,7 @@ class SyncResponse:
             t_origin=d.get("ClockOrigin", 0),
             t_recv=d.get("ClockRecv", 0),
             t_reply=d.get("ClockReply", 0),
+            health=d.get("Health"),
         )
 
 

@@ -139,6 +139,23 @@ class Config:
     # roughly one traced tx per thousand, measured within the 5%
     # overhead bar (docs/observability.md).
     trace_sample: float = 0.0
+    # -- consensus health (docs/observability.md "Consensus health") ---
+    # Divergence sentinel: a rolling chained hash over the committed
+    # block stream, piggybacked on gossip sync RPCs (sidecar field,
+    # legacy wire form unchanged) and compared against peers' claims —
+    # a mismatch at a common index fires babble_divergence_total, a
+    # structured-log alarm, and a /debug/consensus report naming the
+    # fork point. One sha256 per committed block + one dict compare
+    # per gossip round; measured within the 5% bar
+    # (bench.py --health-overhead). False disables the chain, the
+    # piggyback, and the comparison entirely.
+    divergence_sentinel: bool = True
+    # Stall watchdog: when payload events are pending but no consensus
+    # round has decided for this many seconds, emit a diagnosis (which
+    # round is stuck, which witnesses are undecided, which creators
+    # went silent) to the log and /debug/consensus, clearing when a
+    # round decides. 0 disables the watchdog thread.
+    stall_timeout: float = 30.0
     logger: logging.Logger = field(default_factory=_default_logger)
 
 

@@ -148,6 +148,40 @@ class Core:
         self._m_failovers = self._registry.counter(
             "babble_engine_failovers_total",
             "Device->host engine failovers", node=self._node_label)
+        # Fork/equivocation detection (docs/observability.md
+        # "Consensus health"): the insert path's evidence records feed
+        # a per-creator counter. The aggregate child is created eagerly
+        # so the family is scrapeable (at 0) before any fork exists.
+        self._m_forks = self._registry.counter(
+            "babble_forks_total",
+            "Equivocations detected (two signed events by one creator "
+            "at one index)", node=self._node_label)
+        self._fork_counters: Dict[str, object] = {}
+        self.hg.fork_observer = self._on_fork_evidence
+
+    def _on_fork_evidence(self, record: Dict) -> None:
+        """New equivocation evidence from the insert path: count it
+        (aggregate + per-creator) and log the alarm. The record itself
+        is already persisted by the store."""
+        creator = record["creator"]
+        self._m_forks.inc()
+        child = self._fork_counters.get(creator)
+        if child is None:
+            child = self._registry.counter(
+                "babble_forks_total",
+                "Equivocations detected (two signed events by one "
+                "creator at one index)",
+                node=self._node_label, creator=creator[:18])
+            self._fork_counters[creator] = child
+        child.inc()
+        logging.getLogger("babble_tpu").error(
+            "FORK DETECTED: creator %s equivocated at index %d "
+            "(%s vs %s) — evidence recorded",
+            creator[:18], record["index"],
+            record["existing"][:12], record["forged"][:12])
+
+    def forks_detected(self) -> int:
+        return int(self._m_forks.value)
 
     def _timed(self, phase: str, t0: int) -> None:
         dt = time.perf_counter_ns() - t0
@@ -582,6 +616,11 @@ class Core:
                 cb(block)
 
         new_hg = Hashgraph(self.participants, new_store, gated_commit)
+        new_hg.fork_observer = old.fork_observer
+        # Fork evidence is forensic state: carry it into the rebuilt
+        # store so /debug/consensus keeps showing it after failover.
+        for rec in old_store.fork_evidence():
+            new_store.add_fork_evidence(rec)
         for ev in events:
             # Strip device-era consensus annotations so the replay
             # recomputes them from scratch (they would otherwise leak
@@ -691,6 +730,23 @@ class Core:
 
     def get_last_commited_round_events_count(self) -> int:
         return self.hg.last_commited_round_events
+
+    # -- consensus health passthroughs (docs/observability.md) -------------
+
+    def undecided_witness_count(self) -> int:
+        return self.hg.undecided_witness_count()
+
+    def last_decided_fame_round(self) -> int:
+        return self.hg.last_decided_fame_round()
+
+    def dag_window(self, from_round=None, max_rounds: int = 8,
+                   max_events: int = 4096) -> Dict:
+        return self.hg.dag_window(from_round=from_round,
+                                  max_rounds=max_rounds,
+                                  max_events=max_events)
+
+    def fork_evidence(self) -> List[Dict]:
+        return self.hg.store.fork_evidence()
 
     def engine_cost_report(self, wait_s: float = 0.0):
         """Per-pass compiled-cost attribution for the device engine

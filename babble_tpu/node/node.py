@@ -52,6 +52,7 @@ from ..telemetry import ClusterClock, Registry, SpanRing
 from .config import Config
 from .control_timer import ControlTimer
 from .core import Core
+from .health import DivergenceSentinel, StallWatchdog
 from .peer_selector import HealthTrackingPeerSelector, RandomPeerSelector
 from .state import NodeState, StateMachine
 
@@ -128,6 +129,27 @@ class Node:
             node=_nl)
         self._node_label = _nl
         self._rtt_hists: Dict = {}
+        # Consensus health plane (docs/observability.md "Consensus
+        # health"): the divergence sentinel hashes every committed
+        # block into a rolling chain and checks it against the claims
+        # peers piggyback on gossip sync RPCs; the stall watchdog
+        # diagnoses a network that stopped deciding rounds. Both are
+        # cheap enough to stay on (one sha256 per block, one dict
+        # compare per gossip round — bench.py --health-overhead).
+        self.sentinel: Optional[DivergenceSentinel] = (
+            DivergenceSentinel(reg, _nl, self.logger)
+            if getattr(conf, "divergence_sentinel", True) else None)
+        self.watchdog: Optional[StallWatchdog] = None
+        if getattr(conf, "stall_timeout", 0) > 0:
+            self.watchdog = StallWatchdog(self, conf.stall_timeout)
+        # SpanRing drop accounting: the ring silently overwrites its
+        # oldest entry when full; the delta is exported as a counter
+        # at every gauge refresh so scrapers see trace loss.
+        self._m_trace_dropped = reg.counter(
+            "babble_trace_dropped_total",
+            "Spans evicted from the /debug/trace ring before any "
+            "scraper saw them", node=_nl)
+        self._trace_dropped_exported = 0
         # Submit->commit stamping: intake monotonic time per tx
         # payload, bounded (insertion-ordered dict; the oldest stamp
         # is evicted at the cap, so an abandoned tx cannot leak its
@@ -153,6 +175,13 @@ class Node:
         # Preferred sync payload encoding (docs/ingest.md): what this
         # node SENDS and SERVES; both wire forms are always accepted.
         self._wire_format = getattr(conf, "wire_format", "columnar")
+        # participant id -> gossip address, for attributing inbound
+        # sync requests' health sidecars to a peer (the request only
+        # carries from_id).
+        self._addr_by_id: Dict[int, str] = {
+            pmap[p.pub_key_hex]: p.net_addr
+            for p in participants if p.pub_key_hex in pmap
+        }
         self.core_lock = threading.Lock()
         # At most two gossip rounds in flight (see _babble).
         self._gossip_slots = threading.Semaphore(2)
@@ -222,6 +251,16 @@ class Node:
 
     def init(self, bootstrap: bool = False) -> None:
         if bootstrap:
+            # Resume the sentinel's chain segment BEFORE the torn-tail
+            # replay: the persisted state corresponds exactly to the
+            # delivered-block anchor, so the re-emitted tail blocks
+            # extend the chain just like their interrupted first
+            # delivery would have (node/health.py).
+            if self.sentinel is not None:
+                chain_state = getattr(
+                    self.core.hg.store, "chain_state", None)
+                if chain_state is not None:
+                    self.sentinel.chain.restore(chain_state())
             # Bootstrap's torn-tail replay re-emits every undelivered
             # block through the commit callback — normally
             # commit_ch.put on a queue bounded at 400 with no consumer
@@ -254,6 +293,8 @@ class Node:
         self.state.go_func(self._do_background_work)
         if self.conf.consensus_interval > 0:
             self.state.go_func(self._consensus_loop)
+        if self.watchdog is not None:
+            self.state.go_func(self._watchdog_loop)
 
         while True:
             state = self.state.get_state()
@@ -521,6 +562,17 @@ class Node:
             except Exception as exc:  # noqa: BLE001
                 self.logger.debug("shutdown collect failed: %s", exc)
 
+    def _watchdog_loop(self) -> None:
+        """Stall watchdog driver (node/health.py): sample round
+        progress a few times per stall wall so the diagnosis appears —
+        and clears — within a fraction of `stall_timeout`."""
+        interval = max(0.05, min(self.watchdog.timeout / 4.0, 0.5))
+        while not self._shutdown.wait(interval):
+            try:
+                self.watchdog.poll()
+            except Exception as exc:  # noqa: BLE001 - keep the loop alive
+                self.logger.debug("stall watchdog poll failed: %s", exc)
+
     def _throttle_ingest(self) -> None:
         """Ingest flow control (engine_backlog_limit): wait — WITHOUT
         the core lock — until the consensus worker drains the batched
@@ -659,6 +711,12 @@ class Node:
         # as-is; the TCP transport overrides the hint with its own
         # per-peer negotiation).
         req = SyncRequest(self.id, known, t_send=self.clock.epoch_ns())
+        if self.sentinel is not None:
+            # Consensus-health piggyback: chain claim + our last
+            # consensus round ride every pull as a sidecar (outside
+            # any signed body; absent => legacy wire form).
+            req.health = self.sentinel.claim(
+                self.core.get_last_consensus_round_index())
         if self._wire_format == "columnar":
             from ..net.columnar import WIRE_VERSION
 
@@ -676,6 +734,8 @@ class Node:
         if resp.t_recv and resp.t_origin == req.t_send:
             self.clock.observe(
                 peer_addr, req.t_send, resp.t_recv, resp.t_reply, t3)
+        if self.sentinel is not None:
+            self.sentinel.observe(peer_addr, resp.health)
 
         if resp.sync_limit:
             return True, None
@@ -748,6 +808,12 @@ class Node:
                     events = [event_from_json_obj(o) for o in resp.events]
                     with self.core_lock:
                         self.core.fast_forward(roots, events)
+                    if self.sentinel is not None:
+                        # The skipped history can never be re-hashed:
+                        # start a fresh chain segment (claims carry the
+                        # base, so full-history peers skip us instead
+                        # of alarming — node/health.py).
+                        self.sentinel.rebase()
                     self._m_fast_forwards.inc()
                     rec["events"] = len(events)
                     rec["outcome"] = "ok"
@@ -828,6 +894,15 @@ class Node:
             resp.t_origin = cmd.t_send
             resp.t_recv = self.clock.to_epoch(rpc.recv_pc_ns)
             resp.t_reply = self.clock.epoch_ns()
+        if self.sentinel is not None:
+            # Health sidecar, both directions: check the requester's
+            # claim against our chain, answer with ours — every gossip
+            # round doubles as a divergence check (node/health.py).
+            addr = self._addr_by_id.get(cmd.from_id)
+            if addr is not None:
+                self.sentinel.observe(addr, cmd.health)
+            resp.health = self.sentinel.claim(
+                self.core.get_last_consensus_round_index())
         rpc.respond(resp, resp_err)
 
     def _flow_gossip_hop(self, wire_events, hop: str, peer) -> None:
@@ -942,10 +1017,20 @@ class Node:
             # durable marker below has NOT advanced — restart re-emits
             # this block and the journal-keeping proxy must dedupe it.
             os.kill(os.getpid(), signal.SIGKILL)
+        # Divergence sentinel: chain-hash the delivered block, and on
+        # a durable store persist the new link in the SAME commit as
+        # the delivered anchor below — restart resumes chain and
+        # redelivery from the same point (node/health.py).
+        store = self.core.hg.store
+        if self.sentinel is not None:
+            self.sentinel.chain.advance(block)
+            set_chain = getattr(store, "set_chain_state", None)
+            if set_chain is not None:
+                set_chain(self.sentinel.chain.state())
         # Durable delivered anchor AFTER the app delivery: a crash
         # between the two re-delivers (suppressed by the proxy's own
         # journal tail), never loses, the block.
-        self.core.hg.store.set_last_committed_block(block.round_received)
+        store.set_last_committed_block(block.round_received)
 
     def _stamp_tx(self, tx: bytes) -> None:
         """Record the submit->commit intake stamp (first writer wins),
@@ -1015,6 +1100,37 @@ class Node:
         core = self.core
         lcr = core.get_last_consensus_round_index()
         g("babble_last_consensus_round").set(-1 if lcr is None else lcr)
+        # Consensus health plane (docs/observability.md "Consensus
+        # health"): round/fame progress, lag vs the best-known peer
+        # (from the gossip health piggyback), the virtual-voting
+        # frontier, the stall flag, and trace-ring drop accounting.
+        g("babble_last_decided_fame_round",
+          "Highest round with any fame-decided witness").set(
+            core.last_decided_fame_round())
+        g("babble_undecided_witnesses",
+          "Witnesses whose fame is still undefined").set(
+            core.undecided_witness_count())
+        g("babble_round_lag",
+          "Rounds behind the best-known peer's last consensus round"
+          ).set(self.round_lag())
+        g("babble_consensus_stalled",
+          "1 while the stall watchdog has an active diagnosis").set(
+            1 if (self.watchdog is not None
+                  and self.watchdog.diagnosis is not None) else 0)
+        if self.sentinel is not None:
+            chain = self.sentinel.chain
+            g("babble_chain_index",
+              "Committed-block chain tip index (this segment)").set(
+                chain.index)
+            for addr, p in self.sentinel.peer_progress().items():
+                g("babble_peer_last_round",
+                  "Peer's last consensus round (health piggyback)",
+                  peer=addr).set(p["last_known_round"])
+        dropped = self.trace.dropped
+        if dropped > self._trace_dropped_exported:
+            self._m_trace_dropped.inc(
+                dropped - self._trace_dropped_exported)
+            self._trace_dropped_exported = dropped
         g("babble_consensus_events").set(core.get_consensus_events_count())
         g("babble_consensus_txs").set(
             core.get_consensus_transactions_count())
@@ -1114,6 +1230,13 @@ class Node:
             "suspended_peers": str(self._suspended_peer_count()),
             "events_per_second": f"{events_per_second:.2f}",
             "rounds_per_second": f"{rounds_per_second:.2f}",
+            "round_lag": str(self.round_lag()),
+            "stalled": str(self.watchdog is not None
+                           and self.watchdog.diagnosis is not None),
+            "forks_detected": str(self.core.forks_detected()),
+            "divergences": str(
+                0 if self.sentinel is None
+                else self.sentinel.divergence_count()),
             "round_events": str(self.core.get_last_commited_round_events_count()),
             "engine_backlog": str(self.core.engine_backlog()),
             "pipeline_depth": str(getattr(self.conf, "pipeline_depth", 0)),
@@ -1152,3 +1275,61 @@ class Node:
         with self.selector_lock:
             snapshot = getattr(self.peer_selector, "snapshot", None)
             return snapshot() if snapshot else {}
+
+    # -- consensus health views (docs/observability.md) --------------------
+
+    def round_lag(self) -> int:
+        """Rounds this node trails the best-known peer by, from the
+        consensus rounds peers piggyback on gossip (0 when level or
+        ahead, or when the sentinel is off)."""
+        if self.sentinel is None:
+            return 0
+        best = self.sentinel.best_peer_round()
+        mine = self.core.get_last_consensus_round_index()
+        mine = -1 if mine is None else mine
+        return max(0, best - mine)
+
+    def get_peer_progress(self) -> Dict[str, dict]:
+        """Per-peer progress columns for /debug/peers: last known
+        consensus round (health piggyback) and how far behind the
+        best-known round that peer is."""
+        if self.sentinel is None:
+            return {}
+        prog = self.sentinel.peer_progress()
+        mine = self.core.get_last_consensus_round_index()
+        mine = -1 if mine is None else mine
+        best = max([mine] + [p["last_known_round"]
+                             for p in prog.values()])
+        for p in prog.values():
+            p["behind_by"] = max(0, best - p["last_known_round"])
+        return prog
+
+    def get_consensus_health(self) -> Dict[str, object]:
+        """The /debug/consensus payload: chain + divergence reports,
+        round/fame progress, the stall diagnosis, and the persisted
+        fork evidence — the one page to load when 'the cluster is up
+        but consensus looks wrong'."""
+        core = self.core
+        lcr = core.get_last_consensus_round_index()
+        out: Dict[str, object] = {
+            "progress": {
+                "last_consensus_round": -1 if lcr is None else lcr,
+                "last_decided_fame_round": core.last_decided_fame_round(),
+                "undecided_witnesses": core.undecided_witness_count(),
+                "undecided_rounds": sorted(set(core.hg.undecided_rounds)),
+                "round_lag": self.round_lag(),
+                "pending_loaded_events": core.hg.pending_loaded_events,
+            },
+            "stall": (self.watchdog.describe()
+                      if self.watchdog is not None
+                      else {"stalled": False, "watchdog": "disabled"}),
+            "forks": {
+                "detected": core.forks_detected(),
+                "evidence": core.fork_evidence(),
+            },
+        }
+        if self.sentinel is not None:
+            out["sentinel"] = self.sentinel.describe()
+        else:
+            out["sentinel"] = {"enabled": False}
+        return out

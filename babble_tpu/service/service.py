@@ -164,12 +164,49 @@ class Service:
                     # peer circuit-breaker states plus the engine
                     # degradation counters — the first place to look
                     # when a net is slow or a node stopped committing.
-                    core = service.node.core
+                    # Augmented with the consensus-progress columns
+                    # from the gossip health piggyback: each peer's
+                    # last known round and how far behind it trails.
+                    node = service.node
+                    core = node.core
+                    peers = node.get_peer_stats()
+                    for addr, prog in node.get_peer_progress().items():
+                        peers.setdefault(addr, {}).update(prog)
+                    lcr = core.get_last_consensus_round_index()
                     self._json(200, {
                         "engine_state": core.engine_state,
                         "engine_failovers": core.engine_failovers,
-                        "peers": service.node.get_peer_stats(),
+                        "last_consensus_round": (
+                            -1 if lcr is None else lcr),
+                        "round_lag": node.round_lag(),
+                        "peers": peers,
                     })
+                elif url.path.rstrip("/") == "/debug/consensus":
+                    # Consensus health plane (docs/observability.md
+                    # "Consensus health"): chain state + divergence
+                    # reports (fork point per peer), round/fame
+                    # progress, the stall watchdog's live diagnosis,
+                    # and the persisted equivocation evidence.
+                    self._json(200, service.node.get_consensus_health())
+                elif url.path.rstrip("/") == "/debug/hashgraph":
+                    # DAG inspector: a bounded window of the event DAG
+                    # (parent edges + round/witness/fame/received
+                    # annotations) as JSON. Render it to Graphviz DOT
+                    # with `python -m babble_tpu.telemetry.dagdump`.
+                    q = parse_qs(url.query)
+                    try:
+                        from_round = q.get("from", [None])[0]
+                        from_round = (int(from_round)
+                                      if from_round is not None else None)
+                        max_rounds = int(q.get("rounds", ["8"])[0])
+                        max_events = int(q.get("limit", ["4096"])[0])
+                    except ValueError:
+                        self._json(400, {"error": "bad query parameter"})
+                        return
+                    self._json(200, service.node.core.dag_window(
+                        from_round=from_round,
+                        max_rounds=max(1, max_rounds),
+                        max_events=max(1, min(max_events, 65536))))
                 elif url.path.rstrip("/") == "/debug/profile":
                     # Like the reference's pprof mount, this is an
                     # operator tool: bind service_addr to localhost in

@@ -120,14 +120,47 @@ def histogram_snapshot(
                              snap.count)
 
 
+_REQUIRE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?$")
+
+
+def parse_require(spec: str) -> Tuple[str, Dict[str, str]]:
+    """Parse a --require spec: a bare family name, or
+    `NAME{label="value",...}` — the Prometheus selector spelling, so
+    CI can assert per-peer / per-creator series, not just families.
+    Raises ValueError on a malformed spec (a silently-ignored matcher
+    would pass a check it never ran)."""
+    m = _REQUIRE_RE.match(spec.strip())
+    if m is None:
+        raise ValueError(f"malformed require spec {spec!r}")
+    want: Dict[str, str] = {}
+    raw = m.group("labels")
+    if raw is not None:
+        consumed = 0
+        for lm in _LABEL_RE.finditer(raw):
+            want[lm.group("k")] = _unescape(lm.group("v"))
+            consumed = lm.end()
+        if raw[consumed:].strip(", \t") or (raw.strip() and not want):
+            raise ValueError(f"malformed label matchers in {spec!r}")
+    return m.group("name"), want
+
+
 def check_series(samples: Dict[str, List[Sample]],
                  required: Iterable[str]) -> List[str]:
-    """Return the required family names with NO samples in the scrape
-    (histograms count as present when their _count series exists)."""
+    """Return the required specs with NO matching samples in the
+    scrape. A spec is a family name, optionally with label matchers
+    (`NAME{label="value"}`); every matcher must be a subset of some
+    sample's labels. Histograms count as present when their `_count`
+    series matches."""
     missing = []
-    for name in required:
-        if name not in samples and f"{name}_count" not in samples:
-            missing.append(name)
+    for spec in required:
+        name, want = parse_require(spec)
+        rows = list(samples.get(name, ()))
+        rows += samples.get(f"{name}_count", ())
+        if not any(all(labels.get(k) == v for k, v in want.items())
+                   for labels, _v in rows):
+            missing.append(spec)
     return missing
 
 
@@ -138,8 +171,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m babble_tpu.telemetry.promtext",
         description="Validate a Prometheus text scrape from stdin.")
     ap.add_argument("--require", action="append", default=[],
-                    metavar="NAME",
-                    help="fail unless this metric family has samples "
+                    metavar="NAME[{label=\"value\"}]",
+                    help="fail unless this metric family has samples; "
+                         "label matchers select specific series, e.g. "
+                         "babble_forks_total{creator=\"0x04AB\"} "
                          "(repeatable)")
     args = ap.parse_args(argv)
     text = sys.stdin.read()
@@ -148,7 +183,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     except ValueError as exc:
         print(f"promtext: parse error: {exc}", file=sys.stderr)
         return 1
-    missing = check_series(samples, args.require)
+    try:
+        missing = check_series(samples, args.require)
+    except ValueError as exc:
+        print(f"promtext: {exc}", file=sys.stderr)
+        return 1
     if missing:
         print(f"promtext: missing required series: {missing}",
               file=sys.stderr)

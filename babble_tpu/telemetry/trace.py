@@ -51,6 +51,10 @@ class SpanRing:
         # ring).
         self._seq = itertools.count(1)
         self._last_seq = 0
+        # Entries the bounded deque evicted to make room — the ring
+        # used to lose spans silently; scrapers now see the loss as
+        # babble_trace_dropped_total and in the dump's babble block.
+        self._dropped = 0
 
     @contextlib.contextmanager
     def span(self, name: str, cat: str = "node", **args):
@@ -93,6 +97,8 @@ class SpanRing:
             "args": args,
         }
         with self._lock:
+            if len(self._spans) == self.capacity:
+                self._dropped += 1
             entry["seq"] = self._last_seq = next(self._seq)
             self._spans.append(entry)
         return span_id
@@ -117,8 +123,17 @@ class SpanRing:
             "args": args,
         }
         with self._lock:
+            if len(self._spans) == self.capacity:
+                self._dropped += 1
             entry["seq"] = self._last_seq = next(self._seq)
             self._spans.append(entry)
+
+    @property
+    def dropped(self) -> int:
+        """Entries evicted from the full ring before any scraper could
+        fetch them (cumulative)."""
+        with self._lock:
+            return self._dropped
 
     def __len__(self) -> int:
         with self._lock:
@@ -201,7 +216,8 @@ class SpanRing:
                 "args": dict(sp["args"], span_id=sp["id"]),
             })
         out = {"traceEvents": events, "displayTimeUnit": "ms"}
-        babble = {"pid": pid, "next_since": last}
+        babble = {"pid": pid, "next_since": last,
+                  "dropped": self.dropped}
         if meta:
             babble.update(meta)
         out["babble"] = babble

@@ -330,6 +330,18 @@ def _audit_metrics_scrape(node, phases, file_store=False):
             "babble_engine_pass_seconds",
             "babble_sync_requests_total",
             "babble_phase_seconds",
+            # Consensus health plane (docs/observability.md
+            # "Consensus health"): divergence/fork counters exist (at
+            # zero) from boot, progress + stall gauges refresh at
+            # scrape, the trace ring reports drops.
+            "babble_divergence_total",
+            "babble_forks_total",
+            "babble_round_lag",
+            "babble_undecided_witnesses",
+            "babble_last_decided_fame_round",
+            "babble_consensus_stalled",
+            "babble_chain_index",
+            "babble_trace_dropped_total",
         ]
         if file_store:
             required.append("babble_store_fsync_seconds")
@@ -356,7 +368,7 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
                                 store="inmem", store_sync="batch",
                                 metrics_scrape=False, trace_sample=0.0,
                                 wire_format="columnar", heartbeat=None,
-                                transport="inmem"):
+                                transport="inmem", health=True):
     """Throughput of a live localhost testnet: N real nodes (threads,
     inmem transport, signed events, full sync protocol) bombarded with
     transactions; returns (committed consensus events/sec during a
@@ -452,6 +464,12 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
         # 0 keeps the stamping/flow paths as no-ops; the trace-overhead
         # A/B drives this.
         conf.trace_sample = trace_sample
+        # Consensus health plane (docs/observability.md "Consensus
+        # health"): sentinel + stall watchdog are the product default;
+        # health=False is the baseline leg of the --health-overhead
+        # A/B (no chain hashing, no piggyback, no watchdog thread).
+        conf.divergence_sentinel = health
+        conf.stall_timeout = 30.0 if health else 0.0
         if store == "file":
             # Durable-path A/B (docs/robustness.md "Crash recovery"):
             # same testnet over WAL-backed FileStores, so the
@@ -855,6 +873,55 @@ def trace_overhead(reps=4, bar=0.05):
     _emit(payload)
     if overhead > bar:
         log(f"trace overhead {overhead:.1%} exceeds the {bar:.0%} bar")
+        return 1
+    return 0
+
+
+def health_overhead(reps=4, bar=0.05):
+    """Interleaved A/B of the consensus health plane (same protocol as
+    trace_overhead): `reps` back-to-back pairs of the 3-node host
+    smoke with the divergence sentinel + stall watchdog + progress
+    gauges ON (the product default — chain hash per committed block,
+    health sidecar on every gossip pull, watchdog thread polling) vs
+    OFF. The medians must agree within `bar` (5%) or the exit code
+    fails the CI job."""
+    on_rates, off_rates = [], []
+    payload = {
+        "metric": "health_overhead_ab",
+        "nodes": 3,
+        "engine": "host",
+        "reps": reps,
+    }
+    try:
+        for rep in range(reps):
+            for label, health, acc in (("off", False, off_rates),
+                                       ("on", True, on_rates)):
+                eps, _ = node_testnet_events_per_sec(
+                    engine="host", n_nodes=3, warm_s=6.0, window_s=8.0,
+                    interval=0.0, warm_gate_events=150, windows=1,
+                    health=health)
+                acc.append(eps)
+                log(f"  rep {rep} health {label}: {eps:,.1f} ev/s")
+    except Exception as exc:  # noqa: BLE001
+        payload["error"] = str(exc)
+        _emit(payload)
+        return 1
+    off_rates.sort()
+    on_rates.sort()
+    med = lambda xs: (xs[len(xs) // 2] if len(xs) % 2  # noqa: E731
+                      else (xs[len(xs) // 2 - 1] + xs[len(xs) // 2]) / 2)
+    off_med, on_med = med(off_rates), med(on_rates)
+    overhead = 1.0 - on_med / off_med if off_med > 0 else 0.0
+    payload["off_events_per_s"] = [round(x, 1) for x in off_rates]
+    payload["on_events_per_s"] = [round(x, 1) for x in on_rates]
+    payload["off_median"] = round(off_med, 1)
+    payload["on_median"] = round(on_med, 1)
+    payload["overhead_pct"] = round(overhead * 100.0, 2)
+    payload["bar_pct"] = bar * 100.0
+    payload["within_bar"] = overhead <= bar
+    _emit(payload)
+    if overhead > bar:
+        log(f"health overhead {overhead:.1%} exceeds the {bar:.0%} bar")
         return 1
     return 0
 
@@ -1347,5 +1414,7 @@ if __name__ == "__main__":
         sys.exit(node_smoke())
     elif "--trace-overhead" in sys.argv:
         sys.exit(trace_overhead())
+    elif "--health-overhead" in sys.argv:
+        sys.exit(health_overhead())
     else:
         main()

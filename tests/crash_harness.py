@@ -271,6 +271,22 @@ class CrashTestnet:
         return max((n.last_round() for n in self.nodes if n.alive()),
                    default=-1)
 
+    def assert_no_divergence_alarms(self) -> None:
+        """Live audit of the divergence sentinel (docs/observability.md
+        "Consensus health"): after all the kill -9 / restart churn, no
+        node may have flagged a peer's committed-block chain — the
+        sentinel's false-positive bar under real crash recovery."""
+        for node in self.nodes:
+            if not node.alive():
+                continue
+            try:
+                stats = node.stats()
+            except Exception:  # noqa: BLE001 - mid-shutdown
+                continue
+            assert int(stats.get("divergences", "0")) == 0, (
+                f"node {node.index} raised divergence alarms: "
+                f"{stats.get('divergences')}")
+
     # -- the acceptance invariants -----------------------------------------
 
     def assert_invariants(self) -> Dict[str, int]:
@@ -373,6 +389,7 @@ def run_soak(workdir: str, n: int = 4, seed: int = 31337, kills: int = 2,
 
         final = net.max_round() + 2
         net.bombard_until(target_round=final, timeout=300.0)
+        net.assert_no_divergence_alarms()
         log(f"graceful stop at round >= {final}")
     finally:
         net.shutdown_all()

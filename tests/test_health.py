@@ -1,0 +1,473 @@
+"""Consensus health plane tests (docs/observability.md "Consensus
+health"): the committed-block hash chain, the divergence sentinel's
+live detection in a 3-node net (fork index named within one gossip
+round), the stall watchdog's diagnosis + self-clear, the DAG
+inspector endpoint, the dagdump DOT renderer, the wire sidecar's
+legacy byte-identity, the SpanRing drop counter, and promtext's
+labeled --require matchers."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from babble_tpu.hashgraph import Block, InmemStore
+from babble_tpu.hashgraph.health import BlockHashChain
+from babble_tpu.net import FaultyTransport, InmemTransport
+from babble_tpu.net.inmem_transport import connect_all
+from babble_tpu.net.transport import SyncRequest, SyncResponse
+from babble_tpu.node import Node
+from babble_tpu.node.config import test_config as fast_config
+from babble_tpu.node.health import DivergenceSentinel
+from babble_tpu.proxy import InmemAppProxy
+from babble_tpu.telemetry import Registry, SpanRing, promtext
+from babble_tpu.telemetry.dagdump import render_dot
+
+from test_node import check_gossip, make_keyed_peers
+
+CACHE = 10000
+
+
+def _blocks(n, tag=""):
+    return [Block(r, [f"tx{tag}{r}".encode()]) for r in range(1, n + 1)]
+
+
+# ----------------------------------------------------- chain (unit)
+
+
+def test_chain_hash_deterministic_and_ordered():
+    a, b = BlockHashChain(), BlockHashChain()
+    for blk in _blocks(5):
+        a.advance(blk)
+        b.advance(blk)
+    assert a.hash == b.hash and a.index == b.index == 4
+    assert a.base_round == 1 and a.round == 5
+    # Same blocks, different order => different chain (the whole
+    # point: the hash covers ORDER, not just membership).
+    c = BlockHashChain()
+    blocks = _blocks(5)
+    for blk in [blocks[1], blocks[0]] + blocks[2:]:
+        c.advance(blk)
+    assert c.hash != a.hash
+
+
+def test_chain_corrupt_hook_diverges_from_that_block_on():
+    a, b = BlockHashChain(), BlockHashChain()
+    blocks = _blocks(6)
+    for blk in blocks[:3]:
+        a.advance(blk)
+        b.advance(blk)
+    b.corrupt_next()
+    for blk in blocks[3:]:
+        a.advance(blk)
+        b.advance(blk)
+    # Links before the corruption agree; every link after differs.
+    for i in range(3):
+        assert a.lookup(i)[2] == b.lookup(i)[2]
+    for i in range(3, 6):
+        assert a.lookup(i)[2] != b.lookup(i)[2]
+
+
+def test_chain_state_round_trip_and_rebase():
+    a = BlockHashChain()
+    for blk in _blocks(4):
+        a.advance(blk)
+    b = BlockHashChain()
+    b.restore(a.state())
+    assert b.hash == a.hash and b.index == a.index
+    assert b.base_round == a.base_round
+    # The restored chain continues identically.
+    a.advance(Block(9, [b"x"]))
+    b.advance(Block(9, [b"x"]))
+    assert a.hash == b.hash
+    b.rebase()
+    assert b.index == -1 and b.base_round == -1
+    assert "Index" not in b.claim()
+
+
+# ------------------------------------------------- sentinel (unit)
+
+
+def _sentinel(label="0"):
+    import logging
+
+    return DivergenceSentinel(Registry(), label,
+                              logging.getLogger("test"))
+
+
+def test_sentinel_agreement_and_divergence_with_exact_fork_index():
+    s0, s1 = _sentinel("0"), _sentinel("1")
+    blocks = _blocks(6)
+    for blk in blocks[:3]:
+        s0.chain.advance(blk)
+        s1.chain.advance(blk)
+    s0.observe("peer1", s1.claim(3))
+    assert s0.divergence_count() == 0
+    assert s0.peer_progress()["peer1"]["last_agreed_index"] == 2
+    assert s0.peer_progress()["peer1"]["last_known_round"] == 3
+    # Node 1's stream corrupts at block index 3; detection must name
+    # exactly that index (the short-hash window brackets it).
+    s1.chain.corrupt_next()
+    for blk in blocks[3:]:
+        s0.chain.advance(blk)
+        s1.chain.advance(blk)
+    s0.observe("peer1", s1.claim(6))
+    assert s0.divergence_count() == 1
+    (report,) = s0.reports
+    assert report["fork_index"] == 3
+    assert report["fork_round"] == 4  # blocks are rounds 1..6
+    assert report["last_agreed_index"] == 2
+    # Repeated observations keep counting but do not re-report.
+    s0.observe("peer1", s1.claim(6))
+    assert s0.divergence_count() == 2
+    assert len(s0.reports) == 1
+
+
+def test_sentinel_ignores_malformed_peer_claims():
+    """Claims come from untrusted peers: garbage must be dropped, not
+    thrown into the gossip path."""
+    s = _sentinel()
+    for blk in _blocks(3):
+        s.chain.advance(blk)
+    for bad in (None, "junk", 42,
+                {"CRound": "x"},
+                {"CRound": 1, "Index": 2, "Base": 1},  # no Hash
+                {"CRound": 1, "Index": 2, "Base": 1, "Hash": "ab",
+                 "Window": "nope"},
+                {"CRound": 1, "Index": "2", "Base": 1, "Hash": "ab",
+                 "Window": [[1]]}):
+        s.observe("peerX", bad)  # must not raise
+    assert s.divergence_count() == 0
+    assert s.reports == []
+
+
+def test_sentinel_skips_rebased_segments():
+    s0, s1 = _sentinel("0"), _sentinel("1")
+    for blk in _blocks(3):
+        s0.chain.advance(blk)
+    # s1 fast-forwarded: its segment starts at round 5 — different
+    # base, so no comparison and no false alarm either way.
+    s1.rebase()
+    for blk in [Block(5, [b"a"]), Block(6, [b"b"])]:
+        s1.chain.advance(blk)
+    s0.observe("peer1", s1.claim(2))
+    s1.observe("peer0", s0.claim(3))
+    assert s0.divergence_count() == 0
+    assert s1.divergence_count() == 0
+    # Progress tracking still works across segments.
+    assert s0.peer_progress()["peer1"]["last_known_round"] == 2
+
+
+# ------------------------------------------------- wire sidecar
+
+
+def test_health_sidecar_absent_is_byte_identical_legacy_wire():
+    """Pinned like _TraceID: no sentinel => the exact legacy dicts."""
+    req = SyncRequest(3, {0: 4, 1: -1})
+    assert req.to_dict() == {"FromID": 3, "Known": {"0": 4, "1": -1}}
+    resp = SyncResponse(2, known={0: 1})
+    assert resp.to_dict() == {
+        "FromID": 2, "SyncLimit": False, "Events": [],
+        "Known": {"0": 1}}
+    # With the sidecar set, exactly one extra key rides along and
+    # round-trips; legacy decoders ignore it.
+    claim = {"CRound": 7, "Base": 1, "Index": 2, "Round": 5,
+             "Hash": "ab" * 32, "Window": [[2, "ab" * 8]]}
+    req.health = claim
+    d = req.to_dict()
+    assert d["Health"] == claim
+    assert SyncRequest.from_dict(json.loads(json.dumps(d))).health == claim
+    resp.health = claim
+    d = resp.to_dict()
+    assert SyncResponse.from_dict(
+        json.loads(json.dumps(d))).health == claim
+
+
+def test_health_sidecar_rides_columnar_tcp_framing():
+    from babble_tpu.net.tcp_transport import (
+        _pack_sync_response, _unpack_sync_response)
+
+    claim = {"CRound": 4, "Base": 0, "Index": 1, "Round": 3,
+             "Hash": "cd" * 32, "Window": [[1, "cd" * 8]]}
+    resp = SyncResponse(1, known={0: 2}, health=claim)
+    out = _unpack_sync_response(_pack_sync_response(resp))
+    assert out.health == claim
+    assert out.known == {0: 2}
+
+
+# ------------------------------------------------- live 3-node net
+
+
+def _make_net(n=3, heartbeat=0.01, chaos=False, conf_hook=None):
+    inner = [InmemTransport(f"addr{i}", timeout=2.0) for i in range(n)]
+    connect_all(inner)
+    if chaos:
+        trans = {t.local_addr(): FaultyTransport(t, seed=11)
+                 for t in inner}
+    else:
+        trans = {t.local_addr(): t for t in inner}
+    entries = make_keyed_peers(n, addr_fn=lambda i: f"addr{i}")
+    peers = [p for _, p in entries]
+    participants = {p.pub_key_hex: i for i, p in enumerate(peers)}
+    nodes, keys = [], []
+    for i, (key, peer) in enumerate(entries):
+        conf = fast_config(heartbeat=heartbeat)
+        if conf_hook is not None:
+            conf_hook(conf)
+        store = InmemStore(participants, CACHE)
+        node = Node(conf, i, key, peers, store,
+                    trans[peer.net_addr], InmemAppProxy())
+        node.init()
+        nodes.append(node)
+        keys.append(key)
+    return nodes, keys, trans
+
+
+def _drive(nodes, predicate, timeout, submit_to=None, tag="health"):
+    active = submit_to if submit_to is not None else nodes
+    deadline = time.monotonic() + timeout
+    i = 0
+    while time.monotonic() < deadline:
+        active[i % len(active)].submit_tx(f"{tag} tx {i}".encode())
+        i += 1
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError("timeout waiting for predicate")
+
+
+def test_live_divergence_detection_names_fork_index():
+    """Acceptance: a deliberately corrupted block stream (test hook)
+    on one node of a live 3-node net is detected — by its peers and by
+    itself — within one gossip round of the next piggybacked claim,
+    naming the fork index."""
+    nodes, _keys, _ = _make_net(3)
+    try:
+        for nd in nodes:
+            nd.run_async(gossip=True)
+        # Honest warmup: everyone commits blocks, claims agree.
+        _drive(nodes, lambda: all(
+            nd.sentinel.chain.index >= 1 for nd in nodes), 60.0)
+        assert all(nd.sentinel.divergence_count() == 0 for nd in nodes)
+        bad = nodes[2]
+        fork_index = bad.sentinel.chain.corrupt_next()
+
+        def detected():
+            # Wait for an HONEST node to flag the corrupted peer (the
+            # corrupt node also reports its peers, symmetrically, but
+            # the acceptance is peers catching the bad stream).
+            return any(r["peer"] == "addr2"
+                       for nd in nodes[:2] for r in nd.sentinel.reports)
+
+        _drive(nodes, detected, 60.0)
+        reports = [r for nd in nodes for r in nd.sentinel.reports]
+        # Every report names the corrupted chain position exactly —
+        # the short-hash window pins the first diverged index.
+        assert all(r["fork_index"] == fork_index for r in reports), (
+            f"expected fork at {fork_index}, got {reports}")
+        honest = [r for nd in nodes[:2] for r in nd.sentinel.reports]
+        assert any(r["peer"] == "addr2" for r in honest)
+    finally:
+        for nd in nodes:
+            nd.shutdown()
+
+
+def test_stall_watchdog_diagnoses_silenced_creator_and_clears():
+    """Acceptance: with one of 3 creators silenced (crashed chaos
+    transport) no round can decide (supermajority = 3); the watchdog
+    names the stuck round, its undecided witnesses, and the silent
+    creator — and clears once the partition heals."""
+    nodes, _keys, trans = _make_net(
+        3, chaos=True,
+        conf_hook=lambda c: setattr(c, "stall_timeout", 1.0))
+    addr = {i: nodes[i].local_addr for i in range(3)}
+    try:
+        for nd in nodes:
+            nd.run_async(gossip=True)
+        _drive(nodes, lambda: all(
+            (nd.core.get_last_consensus_round_index() or 0) >= 2
+            for nd in nodes), 90.0)
+        assert nodes[0].watchdog.diagnosis is None
+
+        trans[addr[2]].crash()
+        survivors = nodes[:2]
+
+        def stalled():
+            return nodes[0].watchdog.diagnosis is not None
+
+        _drive(nodes, stalled, 45.0, submit_to=survivors)
+        d = nodes[0].watchdog.describe()
+        lcr = nodes[0].core.get_last_consensus_round_index()
+        assert d["stalled"] is True
+        assert d["last_consensus_round"] == lcr
+        assert d["undecided_rounds"], "diagnosis names no round"
+        stuck = d["undecided_rounds"][0]
+        assert stuck["round"] > lcr
+        assert stuck["undecided_witnesses"] > 0
+        assert stuck["undecided"], "no undecided witnesses named"
+        silent_ids = [c["creator_id"] for c in d["silent_creators"]]
+        bad_pid = nodes[2].core.participants[nodes[2].core.hex_id()]
+        assert bad_pid in silent_ids, (
+            f"silenced creator {bad_pid} not in {silent_ids}")
+        # The stall flag reaches /Stats and the gauges.
+        assert nodes[0].get_stats()["stalled"] == "True"
+
+        # Heal: rounds decide again, diagnosis clears itself.
+        trans[addr[2]].restore()
+        target = (lcr or 0) + 2
+
+        def cleared():
+            return (nodes[0].watchdog.diagnosis is None
+                    and (nodes[0].core.get_last_consensus_round_index()
+                         or 0) >= target)
+
+        _drive(nodes, cleared, 90.0)
+        assert nodes[0].watchdog.describe()["stalled"] is False
+    finally:
+        for nd in nodes:
+            nd.shutdown()
+    check_gossip(nodes[:2])
+
+
+def test_dag_inspector_endpoint_and_dagdump_renders_valid_dot():
+    """Acceptance: /debug/hashgraph exports a >=2-round window from a
+    live node; dagdump renders it to structurally valid DOT. Also
+    exercises /debug/consensus and the /debug/peers progress columns
+    off the same run."""
+    from babble_tpu.service import Service
+
+    nodes, _keys, _ = _make_net(3)
+    svc = Service("127.0.0.1:0", nodes[0])
+    svc.serve_async()
+    try:
+        for nd in nodes:
+            nd.run_async(gossip=True)
+        _drive(nodes, lambda: all(
+            (nd.core.get_last_consensus_round_index() or 0) >= 3
+            for nd in nodes), 90.0)
+
+        with urllib.request.urlopen(
+                f"http://{svc.addr}/debug/hashgraph?from=0",
+                timeout=10) as r:
+            window = json.loads(r.read())
+        assert window["to_round"] - window["from_round"] + 1 >= 2
+        assert len(window["events"]) > 5
+        sample = window["events"][0]
+        for key in ("hash", "creator_id", "index", "self_parent",
+                    "other_parent", "round", "witness", "famous",
+                    "round_received"):
+            assert key in sample
+        assert any(e["witness"] for e in window["events"])
+        assert any(e["round_received"] is not None
+                   for e in window["events"])
+
+        dot = render_dot(window, title="test")
+        assert dot.startswith('digraph "test" {')
+        assert dot.rstrip().endswith("}")
+        assert dot.count("{") == dot.count("}")
+        assert "->" in dot and "style=dashed" in dot
+        assert "subgraph cluster_0" in dot
+        # Edge endpoints reference declared nodes only.
+        declared = {ln.split()[0] for ln in dot.splitlines()
+                    if ln.strip().startswith("e") and "[" in ln}
+        for ln in dot.splitlines():
+            if "->" in ln:
+                a, b = ln.strip().rstrip(";").split(" -> ")
+                assert a in declared and b.split(" ")[0] in declared
+
+        # The CLI round-trips through a file.
+        import subprocess
+        import sys
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".json", delete=False) as f:
+            json.dump(window, f)
+        out = subprocess.run(
+            [sys.executable, "-m", "babble_tpu.telemetry.dagdump",
+             f.name], capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.startswith("digraph")
+
+        with urllib.request.urlopen(
+                f"http://{svc.addr}/debug/consensus", timeout=10) as r:
+            health = json.loads(r.read())
+        assert health["sentinel"]["chain"]["index"] >= 0
+        assert health["sentinel"]["divergences"] == 0
+        assert health["progress"]["last_consensus_round"] >= 3
+        assert health["stall"]["stalled"] is False
+        assert health["forks"]["detected"] == 0
+
+        with urllib.request.urlopen(
+                f"http://{svc.addr}/debug/peers", timeout=10) as r:
+            peers = json.loads(r.read())
+        assert "round_lag" in peers and "last_consensus_round" in peers
+        assert any("behind_by" in p for p in peers["peers"].values())
+    finally:
+        for nd in nodes:
+            nd.shutdown()
+        svc.close()
+
+
+# ------------------------------------------------- satellites
+
+
+def test_span_ring_counts_drops_and_reports_in_dump():
+    ring = SpanRing(4)
+    for k in range(7):
+        ring.record(f"s{k}", 0, 1)
+    assert ring.dropped == 3
+    assert len(ring) == 4
+    dump = ring.to_chrome_trace(pid=1)
+    assert dump["babble"]["dropped"] == 3
+    ring.flow("s", 42)
+    assert ring.dropped == 4
+    # Disabled ring: never drops, never counts.
+    off = SpanRing(0)
+    off.record("x", 0, 1)
+    assert off.dropped == 0
+
+
+def test_promtext_require_label_matchers():
+    text = (
+        "# TYPE babble_forks_total counter\n"
+        'babble_forks_total{node="0"} 0\n'
+        'babble_forks_total{creator="0xAB",node="1"} 2\n'
+        "# TYPE babble_phase_seconds histogram\n"
+        'babble_phase_seconds_bucket{phase="sync",le="+Inf"} 1\n'
+        'babble_phase_seconds_sum{phase="sync"} 0.5\n'
+        'babble_phase_seconds_count{phase="sync"} 1\n')
+    samples, _ = promtext.parse(text)
+    assert promtext.check_series(samples, ["babble_forks_total"]) == []
+    assert promtext.check_series(
+        samples, ['babble_forks_total{creator="0xAB"}']) == []
+    assert promtext.check_series(
+        samples, ['babble_forks_total{creator="0xAB",node="1"}']) == []
+    missing = promtext.check_series(
+        samples, ['babble_forks_total{creator="0xZZ"}'])
+    assert missing == ['babble_forks_total{creator="0xZZ"}']
+    # Histograms match through their _count series.
+    assert promtext.check_series(
+        samples, ['babble_phase_seconds{phase="sync"}']) == []
+    assert promtext.check_series(
+        samples, ['babble_phase_seconds{phase="nope"}'])
+    with pytest.raises(ValueError):
+        promtext.check_series(samples, ["babble_forks_total{creator}"])
+
+
+def test_promtext_cli_accepts_label_matchers(monkeypatch):
+    import io
+
+    text = ('# TYPE babble_forks_total counter\n'
+            'babble_forks_total{creator="0xAB"} 1\n')
+    monkeypatch.setattr("sys.stdin", io.StringIO(text))
+    assert promtext.main(
+        ["--require", 'babble_forks_total{creator="0xAB"}']) == 0
+    monkeypatch.setattr("sys.stdin", io.StringIO(text))
+    assert promtext.main(
+        ["--require", 'babble_forks_total{creator="0xZZ"}']) == 1
+    monkeypatch.setattr("sys.stdin", io.StringIO(text))
+    assert promtext.main(["--require", "babble{bad"]) == 1
