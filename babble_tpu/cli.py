@@ -119,6 +119,7 @@ def cmd_run(args) -> int:
         trace_ring=args.trace_ring,
         trace_sample=args.trace_sample,
         divergence_sentinel=not args.no_sentinel,
+        gossip_observatory=not args.no_gossip_observatory,
         stall_timeout=args.stall_timeout / 1000.0,
         wire_format=args.wire_format,
         max_msg_bytes=args.max_msg_bytes << 20,
@@ -249,6 +250,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "committed-block chain hash piggybacked on "
                          "gossip and compared against peers — "
                          "docs/observability.md 'Consensus health')")
+    rn.add_argument("--no_gossip_observatory", action="store_true",
+                    help="disable the gossip efficiency observatory "
+                         "(per-sync redundancy accounting, the "
+                         "creation-stamp wire sidecar, and the "
+                         "propagation-latency histogram — "
+                         "docs/observability.md 'Gossip efficiency')")
     rn.add_argument("--stall_timeout", type=int, default=30000,
                     help="milliseconds without a decided round (while "
                          "payload events are pending) before the stall "

@@ -142,6 +142,12 @@ class Event(GoStruct):
         # the wire form as sidecar metadata and never influences
         # hashes, signatures, or consensus.
         self.trace_id: int = 0
+        # Propagation-tracing annotation (docs/observability.md
+        # "Gossip efficiency"): the creator's cluster-epoch stamp (ns)
+        # taken when the event was signed. Same sidecar contract as
+        # trace_id: never in the signed body, rides the wire forms
+        # only when set, 0 = unstamped (legacy form byte-identical).
+        self.create_ns: int = 0
 
     # -- construction ------------------------------------------------------
 
@@ -290,6 +296,7 @@ class Event(GoStruct):
                 r=self.r,
                 s=self.s,
                 trace_id=self.trace_id,
+                create_ns=self.create_ns,
             )
         return w
 
@@ -356,7 +363,8 @@ class WireEvent(GoStruct):
         ("S", "s"),
     )
 
-    def __init__(self, body: WireBody, r: int, s: int, trace_id: int = 0):
+    def __init__(self, body: WireBody, r: int, s: int, trace_id: int = 0,
+                 create_ns: int = 0):
         self.body = body
         self.r = BigInt(r)
         self.s = BigInt(s)
@@ -366,6 +374,10 @@ class WireEvent(GoStruct):
         # (legacy interop pinned by tests/test_tracing.py) and the
         # Go-JSON marshal (go_fields above) never sees it.
         self.trace_id = trace_id
+        # Creation-stamp sidecar ("_CreateNs"): same only-when-set
+        # contract, feeding the propagation-latency histogram
+        # (docs/observability.md "Gossip efficiency").
+        self.create_ns = create_ns
         self._dict: Optional[dict] = None
 
     def to_dict(self) -> dict:
@@ -394,6 +406,8 @@ class WireEvent(GoStruct):
         }
         if self.trace_id:
             d["_TraceID"] = self.trace_id
+        if self.create_ns:
+            d["_CreateNs"] = self.create_ns
         return d
 
     @classmethod
@@ -415,6 +429,7 @@ class WireEvent(GoStruct):
             r=obj["R"],
             s=obj["S"],
             trace_id=obj.get("_TraceID", 0),
+            create_ns=obj.get("_CreateNs", 0),
         )
 
 
@@ -444,6 +459,7 @@ def materialize_wire_event(
     op_idx: int,
     cid: int,
     trace_id: int = 0,
+    create_ns: int = 0,
 ) -> Event:
     """Zero-rebuild materialization of a columnar wire row into a full
     Event: the Go-JSON body and event encodings are built directly with
@@ -492,6 +508,7 @@ def materialize_wire_event(
         '{"Body":' + body_str + ',"R":' + str(r) + ',"S":' + str(s) + "}")
     ev._creator_hex = _creator_hex(creator_bytes)
     ev.trace_id = trace_id
+    ev.create_ns = create_ns
     return ev
 
 

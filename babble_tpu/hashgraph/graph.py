@@ -522,6 +522,7 @@ class Hashgraph:
 
         ev = Event(body, r=wevent.r, s=wevent.s)
         ev.trace_id = wevent.trace_id
+        ev.create_ns = wevent.create_ns
         return ev
 
     def _batch_resolver(self):
@@ -605,9 +606,11 @@ class Hashgraph:
             body.other_parent_index = wb.other_parent_index
             body.creator_id = wb.creator_id
             ev = Event(body, r=wevent.r, s=wevent.s)
-            # Sidecar tracing annotation survives the hop, so this
-            # node's own diffs relay the id onward (multi-hop flows).
+            # Sidecar annotations survive the hop, so this node's own
+            # diffs relay the trace id and creation stamp onward
+            # (multi-hop flows / propagation latency).
             ev.trace_id = wevent.trace_id
+            ev.create_ns = wevent.create_ns
             local[(wb.creator_id, wb.index)] = ev.hex()
             out.append(ev)
         return out
@@ -632,6 +635,8 @@ class Hashgraph:
         ts_ns = cols.ts_ns.tolist()
         trace = (cols.trace_ids.tolist()
                  if cols.trace_ids is not None else None)
+        created = (cols.create_ns.tolist()
+                   if cols.create_ns is not None else None)
         tx_starts, tx_off = cols.tx_layout()
         creator_bytes: Dict[int, bytes] = {}
 
@@ -651,6 +656,7 @@ class Hashgraph:
                 cols.transactions_of(tx_starts, tx_off, k), r, s,
                 sp_idx[k], op_cid[k], op_idx[k], c,
                 trace_id=trace[k] if trace is not None else 0,
+                create_ns=created[k] if created is not None else 0,
             )
             local[(c, idx[k])] = ev.hex()
             out.append(ev)

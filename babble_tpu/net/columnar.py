@@ -16,6 +16,7 @@ per field instead:
     tx_counts                              int32[n]   -1 = Go nil slice
     tx_lens / tx_blob                      int32[t] + bytes  concatenated txs
     trace_ids                              int64[n]   optional sidecar column
+    create_ns                              int64[n]   optional sidecar column
 
 Everything consensus-visible is in the columns; the signed-body blob
 column the ingest path verifies over is DERIVED on the receiver from
@@ -49,6 +50,7 @@ from ..gojson import Timestamp
 
 MAGIC = b"BBC1"
 _FLAG_TRACE = 1
+_FLAG_CREATE = 2
 
 WIRE_LEGACY = "gojson"
 WIRE_COLUMNAR = "columnar"
@@ -60,15 +62,40 @@ class WireFormatError(ValueError):
     pass
 
 
+def wire_payload_nbytes(events) -> int:
+    """Wire size of a sync payload in either form, for the gossip
+    bytes-per-new-event accounting (docs/observability.md "Gossip
+    efficiency"). Columnar batches report their exact frame size;
+    legacy `List[WireEvent]` payloads report an ESTIMATE of the
+    Go-JSON line (fixed per-event envelope + base64-expanded tx
+    bytes) — close enough for an efficiency ratio without paying a
+    real json.dumps per sync on the hot path. In-process transports
+    never serialize at all, so an estimate is the only number there;
+    the TCP transport's `babble_wire_bytes_total` stays the exact
+    ground truth."""
+    if not isinstance(events, list):
+        return events.nbytes()
+    # Go-JSON envelope per event: body skeleton + 2 sigs at ~77
+    # decimal digits + field names ≈ 330 bytes, then 4/3 per tx byte.
+    size = 0
+    for w in events:
+        size += 330
+        for t in (w.body.transactions or ()):
+            size += 4 * len(t) // 3 + 4
+    return size
+
+
 class ColumnarEvents:
     """One sync batch, one contiguous array per field."""
 
     __slots__ = ("cid", "idx", "sp_idx", "op_cid", "op_idx", "ts_ns",
-                 "sigs", "tx_counts", "tx_lens", "tx_blob", "trace_ids")
+                 "sigs", "tx_counts", "tx_lens", "tx_blob", "trace_ids",
+                 "create_ns")
 
     def __init__(self, cid, idx, sp_idx, op_cid, op_idx, ts_ns, sigs,
                  tx_counts, tx_lens, tx_blob,
-                 trace_ids: Optional[np.ndarray] = None):
+                 trace_ids: Optional[np.ndarray] = None,
+                 create_ns: Optional[np.ndarray] = None):
         self.cid = cid
         self.idx = idx
         self.sp_idx = sp_idx
@@ -80,6 +107,10 @@ class ColumnarEvents:
         self.tx_lens = tx_lens
         self.tx_blob = tx_blob
         self.trace_ids = trace_ids
+        # Creation-stamp sidecar column (docs/observability.md "Gossip
+        # efficiency"): int64[n] creator cluster-epoch ns, same
+        # optional-column contract as trace_ids.
+        self.create_ns = create_ns
 
     def __len__(self) -> int:
         return len(self.cid)
@@ -104,6 +135,7 @@ class ColumnarEvents:
         tx_lens: List[int] = []
         tx_parts: List[bytes] = []
         trace = None
+        created = None
         for k, w in enumerate(wires):
             b = w.body
             cid.append(b.creator_id)
@@ -127,6 +159,10 @@ class ColumnarEvents:
                 if trace is None:
                     trace = np.zeros(n, np.int64)
                 trace[k] = w.trace_id
+            if w.create_ns:
+                if created is None:
+                    created = np.zeros(n, np.int64)
+                created[k] = w.create_ns
         return cls(np.asarray(cid, np.int32), np.asarray(idx, np.int32),
                    np.asarray(sp_idx, np.int32),
                    np.asarray(op_cid, np.int32),
@@ -134,7 +170,7 @@ class ColumnarEvents:
                    np.asarray(ts_ns, np.int64),
                    bytes(sig_parts), np.asarray(tx_counts, np.int32),
                    np.asarray(tx_lens, np.int32), b"".join(tx_parts),
-                   trace)
+                   trace, created)
 
     @classmethod
     def from_events(cls, events: List[Event]) -> "ColumnarEvents":
@@ -195,6 +231,8 @@ class ColumnarEvents:
         ts = self.ts_ns.tolist()
         trace = self.trace_ids.tolist() if self.trace_ids is not None \
             else None
+        created = self.create_ns.tolist() if self.create_ns is not None \
+            else None
         out: List[WireEvent] = []
         for k in range(len(cid)):
             r, s = self.signature(k)
@@ -210,14 +248,30 @@ class ColumnarEvents:
                 ),
                 r=r, s=s,
                 trace_id=trace[k] if trace is not None else 0,
+                create_ns=created[k] if created is not None else 0,
             ))
         return out
 
     # -- binary frame ------------------------------------------------------
 
+    def nbytes(self) -> int:
+        """Exact `encode()` frame size, without building the frame —
+        the cheap bytes-per-new-event accounting hook for in-process
+        transports (docs/observability.md "Gossip efficiency")."""
+        n = len(self)
+        size = 4 + 17 + n * (5 * 4 + 8 + 64 + 4) \
+            + len(self.tx_lens) * 4 + len(self.tx_blob)
+        if self.trace_ids is not None:
+            size += 8 * n
+        if self.create_ns is not None:
+            size += 8 * n
+        return size
+
     def encode(self) -> bytes:
         n = len(self)
         flags = _FLAG_TRACE if self.trace_ids is not None else 0
+        if self.create_ns is not None:
+            flags |= _FLAG_CREATE
         t = len(self.tx_lens)
         head = MAGIC + struct.pack("<IBIQ", n, flags, t,
                                    len(self.tx_blob))
@@ -233,6 +287,9 @@ class ColumnarEvents:
         if self.trace_ids is not None:
             parts.append(
                 np.ascontiguousarray(self.trace_ids, "<i8").tobytes())
+        if self.create_ns is not None:
+            parts.append(
+                np.ascontiguousarray(self.create_ns, "<i8").tobytes())
         return b"".join(parts)
 
     @classmethod
@@ -242,7 +299,8 @@ class ColumnarEvents:
         n, flags, t, blob_len = struct.unpack_from("<IBIQ", buf, 4)
         off = 4 + 17
         need = off + n * (5 * 4 + 8 + 64 + 4) + t * 4 + blob_len \
-            + (n * 8 if flags & _FLAG_TRACE else 0)
+            + (n * 8 if flags & _FLAG_TRACE else 0) \
+            + (n * 8 if flags & _FLAG_CREATE else 0)
         if len(buf) != need:
             raise WireFormatError(
                 f"columnar frame length {len(buf)} != expected {need}")
@@ -272,5 +330,6 @@ class ColumnarEvents:
         tx_blob = buf[off:off + blob_len]
         off += blob_len
         trace = arr("<i8", n, 8) if flags & _FLAG_TRACE else None
+        created = arr("<i8", n, 8) if flags & _FLAG_CREATE else None
         return cls(cid, idx, sp_idx, op_cid, op_idx, ts_ns, sigs,
-                   tx_counts, tx_lens, tx_blob, trace)
+                   tx_counts, tx_lens, tx_blob, trace, created)

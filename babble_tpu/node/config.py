@@ -150,6 +150,17 @@ class Config:
     # (bench.py --health-overhead). False disables the chain, the
     # piggyback, and the comparison entirely.
     divergence_sentinel: bool = True
+    # Gossip efficiency observatory (docs/observability.md "Gossip
+    # efficiency"): per-sync redundancy classification (offered vs
+    # new vs duplicate vs stale-window events, exported per peer and
+    # leg), the known-map bookkeeping phase timer, the creation-stamp
+    # wire sidecar on self-events, and the propagation-latency
+    # histogram. One classification pass + a couple of counter incs
+    # per sync and one clock stamp per self-event — measured within
+    # the 5% bar (bench.py --gossip-overhead). False disables all of
+    # it: no counters, no stamps (wire forms byte-identical to the
+    # pre-observatory encoding), no propagation histogram samples.
+    gossip_observatory: bool = True
     # Stall watchdog: when payload events are pending but no consensus
     # round has decided for this many seconds, emit a diagnosis (which
     # round is stuck, which witnesses are undecided, which creators

@@ -40,6 +40,8 @@ class Core:
         trace: Optional[SpanRing] = None,
         registry: Optional[Registry] = None,
         compile_cache_dir: str = "",
+        clock=None,
+        gossip_observatory: bool = True,
     ):
         self.id = id
         self.key = key
@@ -158,6 +160,21 @@ class Core:
             "at one index)", node=self._node_label)
         self._fork_counters: Dict[str, object] = {}
         self.hg.fork_observer = self._on_fork_evidence
+        # Gossip efficiency observatory (docs/observability.md "Gossip
+        # efficiency"): the owning Node passes its ClusterClock so
+        # self-events get a cluster-epoch creation stamp (the
+        # `_CreateNs` wire sidecar) and remote inserts observe
+        # create->insert latency. A bare Core (tests, tools) has no
+        # clock: nothing is stamped and the wire forms stay
+        # byte-identical to the pre-observatory encoding.
+        self.clock = clock
+        self._observatory = bool(gossip_observatory)
+        self._m_propagation = (
+            self._registry.histogram(
+                "babble_propagation_latency_seconds",
+                "Event creation (creator's cluster-epoch stamp) -> "
+                "local insert latency", node=self._node_label)
+            if gossip_observatory else None)
 
     def _on_fork_evidence(self, record: Dict) -> None:
         """New equivocation evidence from the insert path: count it
@@ -278,6 +295,13 @@ class Core:
             self._recover_head_and_seq()
 
     def sign_and_insert_self_event(self, event: Event) -> None:
+        # Creation stamp BEFORE the wire form is ever memoized: the
+        # sidecar rides every later relay of this event, so peers can
+        # observe create->insert propagation latency against their own
+        # cluster epoch (docs/observability.md "Gossip efficiency").
+        if self._observatory and self.clock is not None:
+            event.create_ns = self.clock.cluster_epoch_ns(
+                time.perf_counter_ns())
         event.sign(self.key)
         self.insert_event(event, True)
 
@@ -288,7 +312,19 @@ class Core:
             self.seq = event.index()
 
     def known(self) -> Dict[int, int]:
-        return self.hg.known()
+        """Known map (participant id -> last index). Timed as the
+        `known` phase: the walk is O(n) in cluster size and runs
+        several times per gossip round (pull request, serve, push
+        gate), so it is the suspected bookkeeping term behind the
+        node16 < node3 inversion — /debug/phases and the soak ledger
+        chart its share directly (docs/observability.md "Gossip
+        efficiency")."""
+        if not self._observatory:
+            return self.hg.known()
+        t0 = time.perf_counter_ns()
+        out = self.hg.known()
+        self._timed("known", t0)
+        return out
 
     def over_sync_limit(self, known: Dict[int, int], sync_limit: int) -> bool:
         tot_unknown = 0
@@ -328,7 +364,8 @@ class Core:
         self._timed("diff", t0)
         return unknown
 
-    def sync(self, unknown: List[WireEvent], unlocked=None) -> None:
+    def sync(self, unknown: List[WireEvent],
+             unlocked=None) -> Dict[str, int]:
         """Insert synced events, then wrap the tx pool and the other
         party's head in a new self-event — reference node/core.go:190-230.
 
@@ -360,15 +397,23 @@ class Core:
         overlap. Duplicates are excluded from verification too (the
         serial path never verified them either); events that become
         duplicates DURING the unlocked verify window are caught by the
-        insert loop's has_event re-check."""
+        insert loop's has_event re-check.
+
+        Returns the batch's redundancy classification
+        (docs/observability.md "Gossip efficiency") — offered events
+        split into new (inserted), duplicate (byte-present already)
+        and stale-window (at or below our known tip yet absent: an
+        aged-out re-offer or a fork probe) — which the owning Node
+        attributes to the peer and leg that delivered the batch."""
         t_sync = time.perf_counter_ns()
 
         with self.trace.span("sync", cat="sync", batch=len(unknown)):
-            self._sync_batch(unknown, unlocked)
+            stats = self._sync_batch(unknown, unlocked)
         self._merge_store_phases()
         self._timed("sync", t_sync)
+        return stats
 
-    def _sync_batch(self, unknown, unlocked=None) -> None:
+    def _sync_batch(self, unknown, unlocked=None) -> Dict[str, int]:
         # Columnar batches get a wire_unpack stamp (the column ->
         # Event materialization is the unpack; the legacy path's JSON
         # decode happened in the transport) so /debug/phases splits the
@@ -398,6 +443,18 @@ class Core:
         # prefix — the write-through hot cache already holds those
         # events, and rolling the database back under it would let
         # later has_event hits mask never-persisted events.
+        # Redundancy classification inputs (docs/observability.md
+        # "Gossip efficiency"): one known-map snapshot per batch tells
+        # a stale-window re-offer (index at or below our tip, hash
+        # absent) apart from a genuinely new event. The snapshot is an
+        # O(n) walk — deliberately charged to the same `known` phase
+        # the accounting exists to measure.
+        tips = (self.known()
+                if (self._observatory and events) else None)
+        n_new = n_stale = 0
+        prop: List[Event] = []  # fresh remote events carrying a stamp
+        my_hex = self.hex_id()
+
         t0 = time.perf_counter_ns()
         other_head = ""
         traced: List[int] = []
@@ -413,21 +470,34 @@ class Core:
                 # event was skipped as a duplicate.
                 fresh = [ev for ev in events if not has_event(ev.hex())]
                 batch_insert(fresh)
-                my_hex = self.hex_id()
                 for ev in fresh:
+                    if (tips is not None and ev.index()
+                            <= tips.get(ev.body.creator_id, -1)):
+                        n_stale += 1
+                    else:
+                        n_new += 1
                     if ev.trace_id:
                         traced.append(ev.trace_id)
                     if ev.creator() == my_hex:
                         self.head = ev.hex()
                         self.seq = ev.index()
+                    elif ev.create_ns:
+                        prop.append(ev)
                 if events:
                     other_head = events[-1].hex()
             else:
                 for k, ev in enumerate(events):
                     if not has_event(ev.hex()):
+                        if (tips is not None and ev.index()
+                                <= tips.get(ev.body.creator_id, -1)):
+                            n_stale += 1
+                        else:
+                            n_new += 1
                         self.insert_event(ev, False)
                         if ev.trace_id:
                             traced.append(ev.trace_id)
+                        if ev.create_ns and ev.creator() != my_hex:
+                            prop.append(ev)
                     if k == len(events) - 1:
                         # Head selection: the peer's head is the LAST
                         # event of its diff even when that event was
@@ -456,6 +526,21 @@ class Core:
         # not turn the ring into flow spam).
         for tid in traced[:16]:
             self.trace.flow("t", tid, cat="sync", hop="recv")
+        # Propagation latency: creator's cluster-epoch stamp -> this
+        # insert, observed per fresh REMOTE stamped event against our
+        # own cluster epoch (both sides rebase onto the shared epoch,
+        # telemetry/clock.py, so cross-node skew cancels to within the
+        # handshake's offset error). Clamped at 0: a residual skew
+        # must not poison the histogram with negative time.
+        if prop and self._m_propagation is not None \
+                and self.clock is not None:
+            now_ns = self.clock.cluster_epoch_ns(time.perf_counter_ns())
+            for ev in prop:
+                self._m_propagation.observe(
+                    max(0, now_ns - ev.create_ns) / 1e9)
+        offered = len(events)
+        return {"offered": offered, "new": n_new,
+                "duplicate": offered - n_new - n_stale, "stale": n_stale}
 
     def add_self_event(self) -> None:
         """Wrap a non-empty tx pool in a new self-event — reference

@@ -129,6 +129,37 @@ class Node:
             node=_nl)
         self._node_label = _nl
         self._rtt_hists: Dict = {}
+        # Gossip efficiency observatory (docs/observability.md "Gossip
+        # efficiency"): per-sync redundancy accounting. The node-level
+        # aggregate children are created eagerly so every family is
+        # scrapeable (at zero) from boot; per-(peer, leg) children are
+        # cached off the label-sort path like the RTT histograms.
+        # Legs: "pull" = batches this node pulled, "push_in" = batches
+        # peers pushed at it.
+        self._observatory = bool(getattr(conf, "gossip_observatory",
+                                         True))
+        self._gossip_children: Dict = {}
+        self._m_gossip_agg: Dict[str, object] = {}
+        if self._observatory:
+            for kind, help_ in (
+                    ("offered", "Events offered to this node in gossip"
+                     " sync batches"),
+                    ("new", "Offered events that were new and inserted"),
+                    ("duplicate", "Offered events already present — "
+                     "redundant gossip"),
+                    ("stale", "Offered events at or below the known tip"
+                     " yet absent (aged-out window re-offers)")):
+                self._m_gossip_agg[kind] = reg.counter(
+                    f"babble_gossip_{kind}_events_total", help_,
+                    node=_nl)
+            self._m_gossip_agg["syncs"] = reg.counter(
+                "babble_gossip_syncs_total",
+                "Gossip sync batches ingested", node=_nl)
+            self._m_gossip_agg["bytes"] = reg.counter(
+                "babble_gossip_payload_bytes_total",
+                "Wire payload bytes of ingested sync batches (exact "
+                "for columnar frames, estimated for Go-JSON lists)",
+                node=_nl)
         # Consensus health plane (docs/observability.md "Consensus
         # health"): the divergence sentinel hashes every committed
         # block into a rolling chain and checks it against the claims
@@ -171,6 +202,8 @@ class Node:
             trace=self.trace,
             registry=self.registry,
             compile_cache_dir=getattr(conf, "compile_cache_dir", ""),
+            clock=self.clock,
+            gossip_observatory=self._observatory,
         )
         # Preferred sync payload encoding (docs/ingest.md): what this
         # node SENDS and SERVES; both wire forms are always accepted.
@@ -697,6 +730,44 @@ class Node:
             self._rtt_hists[(peer_addr, leg)] = child
         return child
 
+    def _record_gossip(self, peer_addr: str, leg: str, stats,
+                       payload) -> None:
+        """Attribute one ingested sync batch's redundancy
+        classification and wire size to (peer, leg) — the raw series
+        behind /debug/gossip's efficiency table. Counter children are
+        cached per key: this runs once per applied sync, not per
+        event."""
+        if not isinstance(stats, dict):
+            return
+        from ..net.columnar import wire_payload_nbytes
+
+        key = (peer_addr, leg)
+        ch = self._gossip_children.get(key)
+        if ch is None:
+            reg = self.registry
+            lb = {"node": self._node_label, "peer": str(peer_addr),
+                  "leg": leg}
+            ch = {kind: reg.counter(
+                f"babble_gossip_{kind}_events_total", "", **lb)
+                for kind in ("offered", "new", "duplicate", "stale")}
+            ch["syncs"] = reg.counter(
+                "babble_gossip_syncs_total", "", **lb)
+            ch["bytes"] = reg.counter(
+                "babble_gossip_payload_bytes_total", "", **lb)
+            self._gossip_children[key] = ch
+        agg = self._m_gossip_agg
+        for kind in ("offered", "new", "duplicate", "stale"):
+            v = stats.get(kind, 0)
+            if v:
+                ch[kind].inc(v)
+                agg[kind].inc(v)
+        nbytes = wire_payload_nbytes(payload)
+        ch["syncs"].inc()
+        agg["syncs"].inc()
+        if nbytes:
+            ch["bytes"].inc(nbytes)
+            agg["bytes"].inc(nbytes)
+
     def _pull_once(self, peer_addr: str):
         if self._shutdown.is_set():
             raise TransportError("node is shutting down")
@@ -744,7 +815,7 @@ class Node:
         with self.core_lock:
             if self._shutdown.is_set():
                 raise TransportError("node is shutting down")
-            self._sync(resp.events)
+            self._sync(resp.events, peer_addr, "pull")
         return False, resp.known
 
     def _push(self, peer_addr: str, known: Dict[int, int]) -> None:
@@ -764,7 +835,7 @@ class Node:
         self._rtt_hist(peer_addr, "push").observe(time.monotonic() - t0)
         self._flow_gossip_hop(wire_events, "push", peer_addr)
 
-    def _sync(self, events) -> None:
+    def _sync(self, events, peer_addr: str = "", leg: str = "") -> None:
         """Insert synced events + run consensus (caller holds core_lock)
         — reference node/node.go:467-487. With consensus_interval > 0
         the pass moves to the dedicated consensus worker: syncs are
@@ -772,8 +843,12 @@ class Node:
         (device) pass. The unlocked seam lets Core.sync release the
         core lock around the batch signature verify (docs/ingest.md):
         this node keeps answering pulls and accepting pushes while the
-        verify pool grinds the batch."""
-        self.core.sync(events, unlocked=self._core_unlocked)
+        verify pool grinds the batch. `peer_addr`/`leg` attribute the
+        batch's redundancy classification to whoever delivered it
+        (docs/observability.md "Gossip efficiency")."""
+        stats = self.core.sync(events, unlocked=self._core_unlocked)
+        if peer_addr and self._observatory:
+            self._record_gossip(peer_addr, leg, stats, events)
         self._syncs_applied += 1
         if self._crash_after_syncs and \
                 self._syncs_applied >= self._crash_after_syncs:
@@ -939,9 +1014,10 @@ class Node:
             rpc.respond(EagerSyncResponse(self.id, False),
                         TransportError("engine backlog over limit"))
             return
+        addr = self._addr_by_id.get(cmd.from_id, f"id{cmd.from_id}")
         with self.core_lock:
             try:
-                self._sync(cmd.events)
+                self._sync(cmd.events, addr, "push_in")
             except Exception as exc:  # noqa: BLE001
                 success = False
                 err = exc
@@ -1303,6 +1379,119 @@ class Node:
         for p in prog.values():
             p["behind_by"] = max(0, best - p["last_known_round"])
         return prog
+
+    # -- gossip efficiency views (docs/observability.md "Gossip ------------
+    # efficiency")
+
+    @staticmethod
+    def _gossip_row(vals: Dict[str, float]) -> Dict[str, object]:
+        """Derived efficiency columns over one raw counter set:
+        redundancy_ratio = duplicates per NEW event (0 = every
+        delivered event was useful), duplicate_share = the same waste
+        as a fraction of everything offered (bounded [0, 1]) — the
+        soak ledger reports the identical definitions."""
+        offered = vals.get("offered", 0)
+        new = vals.get("new", 0)
+        dup = vals.get("duplicate", 0)
+        syncs = vals.get("syncs", 0)
+        nbytes = vals.get("bytes", 0)
+        return {
+            "offered": int(offered),
+            "new": int(new),
+            "duplicate": int(dup),
+            "stale": int(vals.get("stale", 0)),
+            "syncs": int(syncs),
+            "payload_bytes": int(nbytes),
+            "redundancy_ratio": (round(dup / new, 3) if new else None),
+            "duplicate_share": (round(dup / offered, 3)
+                                if offered else None),
+            "new_events_per_sync": (round(new / syncs, 2)
+                                    if syncs else 0.0),
+            "bytes_per_new_event": (round(nbytes / new, 1)
+                                    if new else None),
+        }
+
+    def get_gossip_stats(self) -> Dict[str, object]:
+        """The /debug/gossip payload: node totals, per-peer/leg
+        efficiency rows (redundancy ratio, new events per sync, bytes
+        per new event), outbound RTT p50/p99 from the PR 5 histograms,
+        propagation-latency quantiles, and the known-map bookkeeping
+        wall — the one page that says where gossip bandwidth and time
+        actually go."""
+        if not self._observatory:
+            return {"enabled": False}
+        totals = {k: c.value for k, c in self._m_gossip_agg.items()}
+        peers: Dict[str, Dict] = {}
+        for (peer, leg), ch in list(self._gossip_children.items()):
+            row = self._gossip_row({k: c.value for k, c in ch.items()})
+            peers.setdefault(peer, {})[leg] = row
+        for peer, legs in peers.items():
+            agg: Dict[str, float] = {}
+            for row in legs.values():
+                for k in ("offered", "new", "duplicate", "stale",
+                          "syncs"):
+                    agg[k] = agg.get(k, 0) + row[k]
+                agg["bytes"] = agg.get("bytes", 0) + row["payload_bytes"]
+            legs["totals"] = self._gossip_row(agg)
+            rtts = {}
+            for out_leg in ("pull", "push"):
+                h = self._rtt_hists.get((peer, out_leg))
+                if h is not None and h.count:
+                    snap = h.snapshot()
+                    rtts[out_leg] = {
+                        "p50_ms": round(snap.quantile(0.5) * 1e3, 2),
+                        "p99_ms": round(snap.quantile(0.99) * 1e3, 2),
+                        "samples": snap.count,
+                    }
+            if rtts:
+                legs["rtt"] = rtts
+        out: Dict[str, object] = {
+            "node": self.id,
+            "totals": self._gossip_row(totals),
+            "peers": peers,
+        }
+        prop = getattr(self.core, "_m_propagation", None)
+        if prop is not None and prop.count:
+            snap = prop.snapshot()
+            out["propagation_ms"] = {
+                "p50": round(snap.quantile(0.5) * 1e3, 2),
+                "p99": round(snap.quantile(0.99) * 1e3, 2),
+                "samples": snap.count,
+            }
+        # The known-map bookkeeping wall vs the sync wall — the O(n)
+        # term the epidemic-broadcast rewrite is gated against.
+        phases = self.core.phase_ns
+        known = phases.get("known")
+        sync = phases.get("sync")
+        if known:
+            ent = {"total_ns": known[1], "calls": known[2],
+                   "avg_us": known[1] // max(known[2], 1) // 1000}
+            if sync and sync[1]:
+                ent["share_of_sync_wall"] = round(known[1] / sync[1], 4)
+            out["known_bookkeeping"] = ent
+        return out
+
+    def gossip_peer_efficiency(self) -> Dict[str, Dict]:
+        """Per-peer efficiency columns (legs merged) for /debug/peers:
+        redundancy ratio and bytes per new event next to the breaker
+        and round-lag columns already there."""
+        if not self._observatory:
+            return {}
+        merged: Dict[str, Dict[str, float]] = {}
+        for (peer, _leg), ch in list(self._gossip_children.items()):
+            agg = merged.setdefault(peer, {})
+            for k, c in ch.items():
+                agg[k] = agg.get(k, 0) + c.value
+        out = {}
+        for peer, vals in merged.items():
+            row = self._gossip_row(vals)
+            out[peer] = {
+                "redundancy_ratio": row["redundancy_ratio"],
+                "duplicate_share": row["duplicate_share"],
+                "bytes_per_new_event": row["bytes_per_new_event"],
+                "new_events_per_sync": row["new_events_per_sync"],
+            }
+        return out
 
     def get_consensus_health(self) -> Dict[str, object]:
         """The /debug/consensus payload: chain + divergence reports,

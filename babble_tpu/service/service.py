@@ -159,19 +159,35 @@ class Service:
                                 engine, "cost_report", None),
                         }
                     self._json(200, out)
+                elif url.path.rstrip("/") == "/debug/gossip":
+                    # Gossip efficiency observatory (docs/
+                    # observability.md "Gossip efficiency"): per-peer
+                    # redundancy ratio, new-events-per-sync, bytes per
+                    # new event, RTT quantiles, propagation latency,
+                    # and the known-map bookkeeping wall — the page
+                    # that says how much of the gossip wire actually
+                    # buys new events.
+                    self._json(200, service.node.get_gossip_stats())
                 elif url.path.rstrip("/") == "/debug/peers":
                     # Fault-tolerance view (docs/robustness.md): per-
                     # peer circuit-breaker states plus the engine
                     # degradation counters — the first place to look
                     # when a net is slow or a node stopped committing.
                     # Augmented with the consensus-progress columns
-                    # from the gossip health piggyback: each peer's
-                    # last known round and how far behind it trails.
+                    # from the gossip health piggyback (each peer's
+                    # last known round and how far behind it trails)
+                    # and the efficiency columns from the gossip
+                    # observatory (redundancy ratio, bytes per new
+                    # event) — one endpoint, the whole peer-health
+                    # story.
                     node = service.node
                     core = node.core
                     peers = node.get_peer_stats()
                     for addr, prog in node.get_peer_progress().items():
                         peers.setdefault(addr, {}).update(prog)
+                    for addr, eff in node.gossip_peer_efficiency() \
+                            .items():
+                        peers.setdefault(addr, {}).update(eff)
                     lcr = core.get_last_consensus_round_index()
                     self._json(200, {
                         "engine_state": core.engine_state,

@@ -342,6 +342,18 @@ def _audit_metrics_scrape(node, phases, file_store=False):
             "babble_consensus_stalled",
             "babble_chain_index",
             "babble_trace_dropped_total",
+            # Gossip efficiency observatory (docs/observability.md
+            # "Gossip efficiency"): redundancy accounting counters and
+            # the propagation-latency histogram — aggregate children
+            # exist (at zero) from boot, per-peer ones as soon as a
+            # sync lands.
+            "babble_gossip_offered_events_total",
+            "babble_gossip_new_events_total",
+            "babble_gossip_duplicate_events_total",
+            "babble_gossip_stale_events_total",
+            "babble_gossip_syncs_total",
+            "babble_gossip_payload_bytes_total",
+            "babble_propagation_latency_seconds",
         ]
         if file_store:
             required.append("babble_store_fsync_seconds")
@@ -362,38 +374,17 @@ def _audit_metrics_scrape(node, phases, file_store=False):
         svc.close()
 
 
-def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
-                                window_s=30.0, interval=None,
-                                warm_gate_events=1500, windows=1,
-                                store="inmem", store_sync="batch",
-                                metrics_scrape=False, trace_sample=0.0,
-                                wire_format="columnar", heartbeat=None,
-                                transport="inmem", health=True):
-    """Throughput of a live localhost testnet: N real nodes (threads,
-    inmem transport, signed events, full sync protocol) bombarded with
-    transactions; returns (committed consensus events/sec during a
-    steady-state window after a warmup, per-phase breakdown dict) —
-    the breakdown aggregates every node's Core.phase_ns so a
-    regression in this stage is attributable to a phase (the sustained
-    stage alone had this before). The reference's counterpart is the
-    4-node docker demo steady state (reference docs/usage.rst:31-34)."""
-    import threading
-
-    if engine == "tpu":
-        import jax as _jax
-
-        # The persistent compile cache is the product default (cli.py
-        # enables it for every tpu-engine node); without it the warmup
-        # re-pays every engine-shape compile and the window lands in
-        # the immature phase. child() also sets this, but the function
-        # must be self-sufficient for standalone calls (verification
-        # drives import bench and call it directly). Host-engine runs
-        # never touch JAX, so the --node-smoke CI path stays light.
-        os.makedirs(CACHE_DIR, exist_ok=True)
-        _jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
-        _jax.config.update(
-            "jax_persistent_cache_min_compile_time_secs", 0.0)
-
+def build_host_testnet(n_nodes, engine="host", interval=0.0,
+                       heartbeat=0.0015, store="inmem",
+                       store_sync="batch", trace_sample=0.0,
+                       wire_format="columnar", transport="inmem",
+                       health=True, observatory=True):
+    """Construct (but do not start) a localhost testnet of N real
+    nodes: signed keys, fully-meshed transports, per-node stores and
+    app proxies — the shared builder behind the throughput smoke, the
+    overhead A/Bs, and the gossip soak (one construction path, so a
+    config knob added here is measured everywhere). Returns the node
+    list; callers own run_async/shutdown."""
     import tempfile
 
     from babble_tpu import crypto
@@ -429,15 +420,6 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
         connect_all(transports)
     peers = [p for _, p in entries]
     participants = {p.pub_key_hex: i for i, p in enumerate(peers)}
-    if heartbeat is None:
-        # Host-engine gossip is bounded by round cadence once ingest is
-        # cheap (columnar wire + libcrypto ECDSA): each round yields ~2
-        # events, so the heartbeat IS the throughput ceiling. 1.5 ms
-        # keeps the cluster comfortably inside what the ingest path
-        # sustains (A/B'd 0.01 -> 0.0015: 433 -> 794 ev/s on a 1-core
-        # runner); the tpu engine keeps the 10 ms cadence that paces
-        # its device passes.
-        heartbeat = 0.01 if engine == "tpu" else 0.0015
     nodes = []
     for i, (key, peer) in enumerate(entries):
         conf = test_config(heartbeat=heartbeat, cache_size=100000)
@@ -447,18 +429,6 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
         # node pays; jit caches are process-global) — this is what
         # retired the old 6000-event warm gate.
         conf.engine_prewarm = engine == "tpu"
-        # Batch many syncs per consensus pass. For the tpu engine each
-        # pass costs a ~110 ms tunnel round trip and the nodes share
-        # one chip, so a 1 s cadence keeps the tunnel under 50% duty
-        # (0.25 s oversubscribed it, A/B 68 vs 240 ev/s). For the
-        # 16-node host testnet the same batching amortizes the
-        # undecided-round rescan (A/B 52 vs 78 ev/s); the 4-node host
-        # testnet keeps the reference's per-sync cadence.
-        if interval is None:
-            # tpu: the FLOOR of the adaptive cadence (the worker
-            # tracks ~3x its measured pass wall, see node.py
-            # _consensus_loop).
-            interval = 0.25 if engine == "tpu" else 0.0
         conf.consensus_interval = interval
         # End-to-end tx tracing sample rate (docs/observability.md) —
         # 0 keeps the stamping/flow paths as no-ops; the trace-overhead
@@ -470,6 +440,11 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
         # A/B (no chain hashing, no piggyback, no watchdog thread).
         conf.divergence_sentinel = health
         conf.stall_timeout = 30.0 if health else 0.0
+        # Gossip efficiency observatory (docs/observability.md "Gossip
+        # efficiency"): redundancy accounting + creation-stamp sidecar
+        # + propagation histogram; observatory=False is the baseline
+        # leg of the --gossip-overhead A/B.
+        conf.gossip_observatory = observatory
         if store == "file":
             # Durable-path A/B (docs/robustness.md "Crash recovery"):
             # same testnet over WAL-backed FileStores, so the
@@ -485,6 +460,68 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
                     transports[i], InmemAppProxy())
         node.init()
         nodes.append(node)
+    return nodes
+
+
+def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
+                                window_s=30.0, interval=None,
+                                warm_gate_events=1500, windows=1,
+                                store="inmem", store_sync="batch",
+                                metrics_scrape=False, trace_sample=0.0,
+                                wire_format="columnar", heartbeat=None,
+                                transport="inmem", health=True,
+                                observatory=True):
+    """Throughput of a live localhost testnet: N real nodes (threads,
+    inmem transport, signed events, full sync protocol) bombarded with
+    transactions; returns (committed consensus events/sec during a
+    steady-state window after a warmup, per-phase breakdown dict) —
+    the breakdown aggregates every node's Core.phase_ns so a
+    regression in this stage is attributable to a phase (the sustained
+    stage alone had this before). The reference's counterpart is the
+    4-node docker demo steady state (reference docs/usage.rst:31-34)."""
+    import threading
+
+    if engine == "tpu":
+        import jax as _jax
+
+        # The persistent compile cache is the product default (cli.py
+        # enables it for every tpu-engine node); without it the warmup
+        # re-pays every engine-shape compile and the window lands in
+        # the immature phase. child() also sets this, but the function
+        # must be self-sufficient for standalone calls (verification
+        # drives import bench and call it directly). Host-engine runs
+        # never touch JAX, so the --node-smoke CI path stays light.
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        _jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    if heartbeat is None:
+        # Host-engine gossip is bounded by round cadence once ingest is
+        # cheap (columnar wire + libcrypto ECDSA): each round yields ~2
+        # events, so the heartbeat IS the throughput ceiling. 1.5 ms
+        # keeps the cluster comfortably inside what the ingest path
+        # sustains (A/B'd 0.01 -> 0.0015: 433 -> 794 ev/s on a 1-core
+        # runner); the tpu engine keeps the 10 ms cadence that paces
+        # its device passes.
+        heartbeat = 0.01 if engine == "tpu" else 0.0015
+    # Batch many syncs per consensus pass. For the tpu engine each
+    # pass costs a ~110 ms tunnel round trip and the nodes share
+    # one chip, so a 1 s cadence keeps the tunnel under 50% duty
+    # (0.25 s oversubscribed it, A/B 68 vs 240 ev/s). For the
+    # 16-node host testnet the same batching amortizes the
+    # undecided-round rescan (A/B 52 vs 78 ev/s); the 4-node host
+    # testnet keeps the reference's per-sync cadence.
+    if interval is None:
+        # tpu: the FLOOR of the adaptive cadence (the worker
+        # tracks ~3x its measured pass wall, see node.py
+        # _consensus_loop).
+        interval = 0.25 if engine == "tpu" else 0.0
+    nodes = build_host_testnet(
+        n_nodes, engine=engine, interval=interval, heartbeat=heartbeat,
+        store=store, store_sync=store_sync, trace_sample=trace_sample,
+        wire_format=wire_format, transport=transport, health=health,
+        observatory=observatory)
 
     stop = threading.Event()
     # One process, dozens of pure-Python threads: the default 5 ms GIL
@@ -924,6 +961,326 @@ def health_overhead(reps=4, bar=0.05):
         log(f"health overhead {overhead:.1%} exceeds the {bar:.0%} bar")
         return 1
     return 0
+
+
+def gossip_overhead(reps=4, bar=0.05):
+    """Interleaved A/B of the gossip efficiency observatory (same
+    protocol as trace/health_overhead): `reps` back-to-back pairs of
+    the 3-node host smoke with the observatory ON (the product default
+    — per-sync redundancy classification, the known-map snapshot, the
+    creation-stamp sidecar on every self-event, the propagation
+    histogram) vs OFF. The measurement plane that exists to find waste
+    must not itself be waste: medians must agree within `bar` (5%) or
+    the exit code fails the CI job."""
+    on_rates, off_rates = [], []
+    payload = {
+        "metric": "gossip_overhead_ab",
+        "nodes": 3,
+        "engine": "host",
+        "reps": reps,
+    }
+    try:
+        for rep in range(reps):
+            for label, obs, acc in (("off", False, off_rates),
+                                    ("on", True, on_rates)):
+                eps, _ = node_testnet_events_per_sec(
+                    engine="host", n_nodes=3, warm_s=6.0, window_s=8.0,
+                    interval=0.0, warm_gate_events=150, windows=1,
+                    observatory=obs)
+                acc.append(eps)
+                log(f"  rep {rep} observatory {label}: {eps:,.1f} ev/s")
+    except Exception as exc:  # noqa: BLE001
+        payload["error"] = str(exc)
+        _emit(payload)
+        return 1
+    off_rates.sort()
+    on_rates.sort()
+    med = lambda xs: (xs[len(xs) // 2] if len(xs) % 2  # noqa: E731
+                      else (xs[len(xs) // 2 - 1] + xs[len(xs) // 2]) / 2)
+    off_med, on_med = med(off_rates), med(on_rates)
+    overhead = 1.0 - on_med / off_med if off_med > 0 else 0.0
+    payload["off_events_per_s"] = [round(x, 1) for x in off_rates]
+    payload["on_events_per_s"] = [round(x, 1) for x in on_rates]
+    payload["off_median"] = round(off_med, 1)
+    payload["on_median"] = round(on_med, 1)
+    payload["overhead_pct"] = round(overhead * 100.0, 2)
+    payload["bar_pct"] = bar * 100.0
+    payload["within_bar"] = overhead <= bar
+    _emit(payload)
+    if overhead > bar:
+        log(f"gossip overhead {overhead:.1%} exceeds the {bar:.0%} bar")
+        return 1
+    return 0
+
+
+# --------------------------------------------------------------------------
+# Cluster-scaling gossip soak (docs/observability.md "Gossip efficiency"):
+# the instrument the epidemic-broadcast rewrite will be accepted
+# against. For each n it runs a live host testnet for a fixed wall,
+# scrapes /metrics on an interval into a JSONL time-series ledger, and
+# summarizes the per-n efficiency curves — per-node ev/s, redundancy
+# ratio, duplicate share, propagation p50/p99, coverage time, and the
+# known-map bookkeeping share the O(n) hypothesis blames.
+# --------------------------------------------------------------------------
+
+
+def _soak_coverage_probe(nodes, timeout=15.0):
+    """Coverage time: wall seconds for node 0's NEXT self-event to
+    become known to every node (the known maps are read through the
+    raw store path so the probe does not inflate the `known` phase it
+    is measuring). None when the net is too stalled to measure."""
+    n0 = nodes[0]
+    pid0 = n0.core.participants[n0.core.hex_id()]
+    target = n0.core.seq + 1
+    deadline = time.monotonic() + timeout
+    while n0.core.seq < target:
+        if time.monotonic() > deadline:
+            return None
+        time.sleep(0.001)
+    t0 = time.monotonic()
+    remaining = set(range(1, len(nodes)))
+    while remaining:
+        if time.monotonic() > deadline:
+            return None
+        for i in list(remaining):
+            if nodes[i].core.hg.known().get(pid0, -1) >= target:
+                remaining.discard(i)
+        time.sleep(0.002)
+    return time.monotonic() - t0
+
+
+def gossip_soak_leg(n, wall_s, scrape_s, ts_file, probes=5):
+    """One soak leg: n in-process host nodes under continuous load for
+    `wall_s` of measurement, /metrics scraped over real HTTP every
+    `scrape_s` (parse-validated) with per-node counter rows appended
+    to the JSONL ledger `ts_file`. Returns the leg summary dict."""
+    import threading
+    import urllib.request
+
+    from babble_tpu.service import Service
+    from babble_tpu.telemetry import promtext
+
+    # n >= 16 batches several syncs per consensus pass, matching the
+    # node16 smoke leg (amortizes the undecided-round rescan).
+    interval = 0.5 if n >= 16 else 0.0
+    nodes = build_host_testnet(n, engine="host", interval=interval,
+                               heartbeat=0.0015)
+    svc = Service("127.0.0.1:0", nodes[0])
+    svc.serve_async()
+    stop = threading.Event()
+    coverage: list = []
+
+    def bombard():
+        i = 0
+        while not stop.is_set():
+            try:
+                nodes[i % n].submit_tx(f"soak tx {i}".encode())
+            except Exception:  # noqa: BLE001
+                pass
+            i += 1
+            time.sleep(0.002)
+
+    def probe_loop():
+        gap = max(wall_s / (probes + 1), 0.5)
+        while not stop.is_set() and len(coverage) < probes:
+            c = _soak_coverage_probe(nodes)
+            if c is not None:
+                coverage.append(c)
+            if stop.wait(gap):
+                return
+
+    committed = lambda: min(  # noqa: E731
+        len(nd.core.get_consensus_events()) for nd in nodes)
+    agg_snap = lambda nd: {  # noqa: E731
+        k: c.value for k, c in nd._m_gossip_agg.items()}
+
+    import sys as _sys
+    old_switch = _sys.getswitchinterval()
+    _sys.setswitchinterval(0.1)
+    rows_written = 0
+    try:
+        for nd in nodes:
+            nd.run_async(gossip=True)
+        threading.Thread(target=bombard, daemon=True).start()
+        # Warmup: first commits prove the net is live before the
+        # measurement window opens.
+        deadline = time.monotonic() + max(6.0, wall_s / 3.0)
+        while time.monotonic() < deadline and committed() < 100:
+            time.sleep(0.25)
+        threading.Thread(target=probe_loop, daemon=True).start()
+
+        c0, t0 = committed(), time.monotonic()
+        g0 = [agg_snap(nd) for nd in nodes]
+        p0 = [nd.core._m_propagation.snapshot() for nd in nodes]
+        phase0: dict = {}
+        for nd in nodes:
+            for ph, ent in list(nd.core.phase_ns.items()):
+                phase0[ph] = phase0.get(ph, 0) + ent[1]
+        with open(ts_file, "a") as ts:
+            while time.monotonic() - t0 < wall_s:
+                time.sleep(scrape_s)
+                now = round(time.monotonic() - t0, 2)
+                # Real HTTP scrape of node 0 — parse-validated, the
+                # same bytes a Prometheus server would ingest.
+                with urllib.request.urlopen(
+                        f"http://{svc.addr}/metrics", timeout=10) as r:
+                    samples, _ = promtext.parse(r.read().decode())
+                scraped = {
+                    kind: sum(
+                        v for lb, v in samples.get(
+                            f"babble_gossip_{kind}_events_total", [])
+                        if lb.get("node") == "0" and "peer" not in lb)
+                    for kind in ("offered", "new", "duplicate")}
+                ts.write(json.dumps(
+                    {"t": now, "n": n, "node": "scrape0"} | scraped)
+                    + "\n")
+                rows_written += 1
+                for i, nd in enumerate(nodes):
+                    snap = agg_snap(nd)
+                    ts.write(json.dumps({
+                        "t": now, "n": n, "node": i,
+                        "consensus_events":
+                            len(nd.core.get_consensus_events()),
+                        **{k: int(v) for k, v in snap.items()},
+                    }) + "\n")
+                    rows_written += 1
+        wall = time.monotonic() - t0
+        c1 = committed()
+        g1 = [agg_snap(nd) for nd in nodes]
+        prop = None
+        for nd, before in zip(nodes, p0):
+            delta = nd.core._m_propagation.snapshot() - before
+            prop = delta if prop is None else prop.merge(delta)
+        phase1: dict = {}
+        for nd in nodes:
+            for ph, ent in list(nd.core.phase_ns.items()):
+                phase1[ph] = phase1.get(ph, 0) + ent[1]
+    finally:
+        _sys.setswitchinterval(old_switch)
+        stop.set()
+        for nd in nodes:
+            nd.shutdown()
+        svc.close()
+
+    tot = {k: sum(b[k] - a[k] for a, b in zip(g0, g1))
+           for k in g0[0]} if g0 else {}
+    offered = tot.get("offered", 0)
+    new = tot.get("new", 0)
+    dup = tot.get("duplicate", 0)
+    # Pacing/bookkeeping attribution over the window (same share
+    # denominators as node_testnet_events_per_sec).
+    dphase = {ph: phase1.get(ph, 0) - phase0.get(ph, 0) for ph in phase1}
+    ingest = ("from_wire", "wire_unpack", "verify", "insert")
+    top = {ph: v for ph, v in dphase.items()
+           if not ph.startswith("engine_") and ph not in ingest
+           and ph != "store_commit" and v > 0}
+    top_sum = sum(top.values())
+    leg = {
+        "n": n,
+        "wall_s": round(wall, 1),
+        "events_per_s": round((c1 - c0) / wall, 1),
+        "offered_events": int(offered),
+        "new_events": int(new),
+        "duplicate_events": int(dup),
+        "stale_events": int(tot.get("stale", 0)),
+        "payload_bytes": int(tot.get("bytes", 0)),
+        # duplicates per NEW event: the gossip amplification waste
+        # (0 = perfect); duplicate_share is the same waste as a
+        # fraction of everything offered (bounded [0, 1]).
+        "redundancy_ratio": round(dup / new, 3) if new else None,
+        "duplicate_share": round(dup / offered, 3) if offered else None,
+        "bytes_per_new_event": round(tot.get("bytes", 0) / new, 1)
+        if new else None,
+        "coverage_ms": (round(
+            1e3 * sorted(coverage)[len(coverage) // 2], 1)
+            if coverage else None),
+        "coverage_probes": len(coverage),
+        "timeseries_rows": rows_written,
+    }
+    if prop is not None and prop.count:
+        leg["propagation_p50_ms"] = round(prop.quantile(0.5) * 1e3, 2)
+        leg["propagation_p99_ms"] = round(prop.quantile(0.99) * 1e3, 2)
+        leg["propagation_samples"] = prop.count
+    if top_sum:
+        leg["phase_share"] = {ph: round(v / top_sum, 3)
+                              for ph, v in sorted(top.items())}
+        # The suspected O(n) term: known-map walks + diff merges as a
+        # share of the top-level phase wall.
+        leg["bookkeeping_share"] = round(
+            (dphase.get("known", 0) + dphase.get("diff", 0)) / top_sum,
+            3)
+    if dphase.get("sync"):
+        # Inside the sync wall (docs/ingest.md): materialize / verify /
+        # insert split — when `sync` dominates the leg, this names the
+        # stage that grew with n.
+        leg["ingest_phase_share"] = {
+            ph: round(dphase.get(ph, 0) / dphase["sync"], 3)
+            for ph in ingest if dphase.get(ph)}
+    return leg
+
+
+def gossip_soak():
+    """`bench.py --soak`: the cluster-scaling soak ledger. Legs and
+    wall come from SOAK_NS / SOAK_WALL_S / SOAK_SCRAPE_S (defaults
+    n∈{3,8,16,32}, 45 s, 2 s) so CI can run a {3,8} smoke against the
+    same committed SOAK_SMOKE.json baseline (bench_compare gates the
+    keys both payloads carry). Emits one JSON payload; the raw
+    time-series JSONL lands in SOAK_OUT_DIR."""
+    import tempfile
+
+    ns = [int(x) for x in os.environ.get(
+        "SOAK_NS", "3,8,16,32").split(",") if x.strip()]
+    wall_s = float(os.environ.get("SOAK_WALL_S", "45"))
+    scrape_s = float(os.environ.get("SOAK_SCRAPE_S", "2.0"))
+    out_dir = os.environ.get("SOAK_OUT_DIR") or tempfile.mkdtemp(
+        prefix="babble-soak-")
+    os.makedirs(out_dir, exist_ok=True)
+    ts_file = os.path.join(out_dir, "soak_timeseries.jsonl")
+    payload = {
+        "metric": "gossip_soak",
+        "unit": "events/s",
+        "engine": "host",
+        "wall_s_per_leg": wall_s,
+        "timeseries_jsonl": ts_file,
+        "legs": {},
+    }
+    try:
+        # The shared machine-speed yardstick (see bench_compare.py).
+        calib_eps, _, _ = host_engine_events_per_sec(64, 5000)
+        payload["host_events_per_s"] = round(calib_eps, 1)
+        payload["host_events"] = 5000
+    except Exception as exc:  # noqa: BLE001
+        payload["calibration_error"] = str(exc)
+    failures = 0
+    for n in ns:
+        log(f"soak leg n={n}: {wall_s:.0f}s wall, "
+            f"scrape every {scrape_s:.1f}s")
+        try:
+            leg = gossip_soak_leg(n, wall_s, scrape_s, ts_file)
+        except Exception as exc:  # noqa: BLE001
+            payload[f"soak{n}_error"] = str(exc)
+            failures += 1
+            _emit(payload)
+            continue
+        payload["legs"][str(n)] = leg
+        payload[f"soak{n}_events_per_s"] = leg["events_per_s"]
+        for k in ("redundancy_ratio", "duplicate_share",
+                  "bytes_per_new_event", "propagation_p50_ms",
+                  "propagation_p99_ms", "coverage_ms",
+                  "bookkeeping_share"):
+            if leg.get(k) is not None:
+                payload[f"soak{n}_{k}"] = leg[k]
+        log(f"  n={n}: {leg['events_per_s']:,.1f} ev/s, redundancy "
+            f"{leg['redundancy_ratio']}, dup share "
+            f"{leg['duplicate_share']}, propagation p99 "
+            f"{leg.get('propagation_p99_ms')} ms, bookkeeping share "
+            f"{leg.get('bookkeeping_share')}")
+        _emit(payload)
+    payload["node_scaling_events_per_s"] = {
+        str(n): payload[f"soak{n}_events_per_s"]
+        for n in ns if f"soak{n}_events_per_s" in payload}
+    _emit(payload)
+    return 1 if failures else 0
 
 
 def child():
@@ -1416,5 +1773,9 @@ if __name__ == "__main__":
         sys.exit(trace_overhead())
     elif "--health-overhead" in sys.argv:
         sys.exit(health_overhead())
+    elif "--gossip-overhead" in sys.argv:
+        sys.exit(gossip_overhead())
+    elif "--soak" in sys.argv:
+        sys.exit(gossip_soak())
     else:
         main()

@@ -55,7 +55,9 @@ from typing import Dict, List, Optional, Tuple
 # measured across repeated smoke runs, p50 swings ~25% with where the
 # measurement window lands in the gossip cadence while p99 (pinned by
 # the heartbeat/commit cadence) is stable within ~1% — p99 is the SLO
-# number, p50 is context.
+# number, p50 is context. `ratio` is lower-better and NOT machine
+# normalized (a redundancy or bookkeeping share is a property of the
+# protocol, not the runner); any `*-info` kind prints without gating.
 HEADLINES: Dict[str, str] = {
     "value": "throughput",
     "smoke_events_per_s": "throughput",
@@ -75,6 +77,23 @@ HEADLINES: Dict[str, str] = {
     "file_commit_latency_p50_ms": "latency-info",
     "file_commit_latency_p99_ms": "latency",
 }
+
+# Gossip soak ledger (bench.py --soak, docs/observability.md "Gossip
+# efficiency"): per-leg scaling curves. Gated per leg: committed ev/s
+# (throughput), propagation p99 (latency), and the redundancy ratio
+# (ratio — duplicates per new event; the epidemic-broadcast rewrite
+# must push it DOWN, and a regression here means gossip got wastier).
+# The rest ride as info: coverage and p50 swing with scheduler luck,
+# and the bookkeeping share is diagnosis, not an SLO.
+for _n in (3, 8, 16, 32, 64):
+    HEADLINES[f"soak{_n}_events_per_s"] = "throughput"
+    HEADLINES[f"soak{_n}_propagation_p99_ms"] = "latency"
+    HEADLINES[f"soak{_n}_redundancy_ratio"] = "ratio"
+    HEADLINES[f"soak{_n}_duplicate_share"] = "ratio-info"
+    HEADLINES[f"soak{_n}_bytes_per_new_event"] = "ratio-info"
+    HEADLINES[f"soak{_n}_propagation_p50_ms"] = "latency-info"
+    HEADLINES[f"soak{_n}_coverage_ms"] = "latency-info"
+    HEADLINES[f"soak{_n}_bookkeeping_share"] = "ratio-info"
 
 YARDSTICK = "host_events_per_s"
 
@@ -115,6 +134,12 @@ def compare(fresh: dict, baseline: dict, tolerance: float,
             expected = b * scale if scale else b
             delta = f / expected - 1.0
             bad = delta < -tolerance
+        elif kind.startswith("ratio"):
+            # Protocol-shape metrics: machine speed cancels out of a
+            # ratio, so no yardstick normalization either way.
+            expected = b
+            delta = f / expected - 1.0
+            bad = delta > tolerance
         else:
             expected = b / scale if scale else b
             delta = f / expected - 1.0
@@ -123,7 +148,7 @@ def compare(fresh: dict, baseline: dict, tolerance: float,
         row["delta_pct"] = round(delta * 100.0, 1)
         if scale and key == YARDSTICK:
             row["status"] = "yardstick"
-        elif not gate or kind == "latency-info":
+        elif not gate or kind.endswith("-info"):
             row["status"] = "info"
         elif bad:
             row["status"] = "REGRESSION"

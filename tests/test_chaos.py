@@ -252,6 +252,9 @@ def test_chaos_quick_convergence():
         for k, v in nd.trans.injected.items():
             total[k] = total.get(k, 0) + v
     assert total["drop"] > 0 and total["duplicate"] > 0
+    # Injected duplicate pushes are visible in the redundancy
+    # accounting (docs/observability.md "Gossip efficiency").
+    assert sum(nd._m_gossip_agg["duplicate"].value for nd in nodes) > 0
     # Live chain-hash invariant: checked every gossip round under the
     # injected faults, zero false alarms (node/health.py).
     for nd in nodes:
@@ -369,3 +372,18 @@ def test_chaos_soak():
         for p in nd.sentinel.peer_progress().values()
         if p["last_agreed_index"] >= 0)
     assert compared > 0, "no cross-node chain comparison ever happened"
+    # Gossip efficiency audit (docs/observability.md "Gossip
+    # efficiency"): the chaos transport injected duplicate pushes
+    # (at-least-once delivery) — the redundancy accounting must have
+    # SEEN them as duplicate offered events, closing the loop between
+    # fault injection and the new counters. Every offered event lands
+    # in exactly one classification bucket.
+    assert injected["duplicate"] > 0
+    dup_seen = sum(nd._m_gossip_agg["duplicate"].value for nd in nodes)
+    assert dup_seen > 0, (
+        "injected duplicate pushes never surfaced in "
+        "babble_gossip_duplicate_events_total")
+    for nd in nodes:
+        agg = {k: c.value for k, c in nd._m_gossip_agg.items()}
+        assert agg["offered"] == agg["new"] + agg["duplicate"] \
+            + agg["stale"], f"node {nd.id} classification leak: {agg}"

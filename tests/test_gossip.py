@@ -1,0 +1,399 @@
+"""Gossip efficiency observatory (docs/observability.md "Gossip
+efficiency").
+
+Covers the measurement plane end to end:
+
+- `Core.sync` classifies every offered event as new / duplicate /
+  stale-window and returns the counts;
+- self-events carry the cluster-epoch creation stamp, which rides both
+  wire codecs as the `_CreateNs` sidecar — absent ⇒ byte-identical
+  legacy and columnar forms (pinned like `_TraceID`), present ⇒
+  round-trips through both and mixed-format clusters still commit
+  byte-identical blocks;
+- propagation latency (create -> remote insert) lands in the
+  per-node histogram;
+- the Node attributes classifications per (peer, leg), `/debug/gossip`
+  renders the efficiency table, `/debug/peers` gains the redundancy
+  columns, and `FaultyTransport`'s duplicate-push injection shows up
+  in `babble_gossip_duplicate_events_total` (the loop between fault
+  injection and the accounting);
+- `bench_compare`'s soak shape extension gates redundancy ratios
+  un-normalized.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import babble_tpu.gojson as gojson
+from babble_tpu import crypto
+from babble_tpu.gojson import Timestamp
+from babble_tpu.hashgraph.event import WireBody, WireEvent
+from babble_tpu.hashgraph.inmem_store import InmemStore
+from babble_tpu.net import FaultyTransport, InmemTransport
+from babble_tpu.net.columnar import ColumnarEvents, wire_payload_nbytes
+from babble_tpu.net.inmem_transport import connect_all
+from babble_tpu.node import Node
+from babble_tpu.node.config import test_config as fast_config
+from babble_tpu.node.core import Core
+from babble_tpu.proxy import InmemAppProxy
+from babble_tpu.telemetry import ClusterClock
+
+from test_node import check_gossip, make_keyed_peers
+
+CACHE = 10000
+
+
+def _three_cores(clock=False, seed_base=7300):
+    keys = sorted((crypto.key_from_seed(seed_base + i) for i in range(3)),
+                  key=lambda k: crypto.pub_key_bytes(k).hex().upper())
+    parts = {"0x" + crypto.pub_key_bytes(k).hex().upper(): i
+             for i, k in enumerate(keys)}
+    cores = [Core(i, k, parts, InmemStore(parts, CACHE),
+                  clock=ClusterClock() if clock else None)
+             for i, k in enumerate(keys)]
+    for c in cores:
+        c.init()
+    return cores
+
+
+def _wire_event(create_ns=0, trace_id=0, txs=(b"tx",), idx=1):
+    return WireEvent(
+        WireBody(
+            transactions=list(txs),
+            self_parent_index=idx - 1,
+            other_parent_creator_id=1,
+            other_parent_index=0,
+            creator_id=0,
+            timestamp=Timestamp(1_700_000_000_000_000_123),
+            index=idx,
+        ),
+        r=12345, s=67890, trace_id=trace_id, create_ns=create_ns)
+
+
+# -------------------------------------------------- sync classification
+
+
+def test_sync_classifies_new_then_duplicate():
+    a, b, _ = _three_cores()
+    diff = a.diff(b.known())
+    payload = a.to_wire_batch(diff, "columnar")
+    stats = b.sync(payload)
+    assert stats["offered"] == len(diff)
+    assert stats["new"] == len(diff)
+    assert stats["duplicate"] == 0 and stats["stale"] == 0
+    # The same payload again: every offered event is now a duplicate.
+    stats = b.sync(a.to_wire_batch(diff, "columnar"))
+    assert stats["offered"] == len(diff)
+    assert stats["new"] == 0
+    assert stats["duplicate"] == len(diff)
+
+
+def test_sync_classification_matches_on_legacy_payloads():
+    a, b, _ = _three_cores()
+    diff = a.diff(b.known())
+    stats = b.sync(a.to_wire_batch(diff, "gojson"))
+    assert stats == {"offered": len(diff), "new": len(diff),
+                     "duplicate": 0, "stale": 0}
+    stats = b.sync(a.to_wire_batch(diff, "gojson"))
+    assert stats["duplicate"] == len(diff) and stats["new"] == 0
+
+
+# ------------------------------------------------- creation-stamp sidecar
+
+
+def test_self_events_carry_cluster_epoch_stamp():
+    (a,) = _three_cores(clock=True)[:1]
+    head = a.get_head()
+    assert head.create_ns > 0
+    w = head.to_wire()
+    assert w.create_ns == head.create_ns
+    assert w.to_dict()["_CreateNs"] == head.create_ns
+
+
+def test_bare_core_never_stamps():
+    (a,) = _three_cores()[:1]
+    assert a.get_head().create_ns == 0
+    assert "_CreateNs" not in a.get_head().to_wire().to_dict()
+
+
+def test_sidecar_absent_is_byte_identical_both_codecs():
+    plain = [_wire_event(), _wire_event(idx=2)]
+    # legacy Go-JSON: no sidecar key at all when unstamped
+    d = plain[0].to_dict()
+    assert "_CreateNs" not in d and "_TraceID" not in d
+    # columnar: no column, no flag bit, frame grows by exactly 8n when
+    # the stamp appears (pinned like the trace column)
+    buf = ColumnarEvents.from_wire_events(plain).encode()
+    assert buf[8] & 2 == 0  # flags byte: create column absent
+    stamped = [_wire_event(create_ns=123456789),
+               _wire_event(idx=2)]
+    sbuf = ColumnarEvents.from_wire_events(stamped).encode()
+    assert sbuf[8] & 2 == 2
+    assert len(sbuf) == len(buf) + 2 * 8
+
+
+def test_sidecar_round_trips_both_codecs():
+    w = _wire_event(create_ns=1_723_400_000_123_456_789, trace_id=7)
+    # columnar
+    cols = ColumnarEvents.decode(
+        ColumnarEvents.from_wire_events([w]).encode())
+    back = cols.to_wire_events()[0]
+    assert back.create_ns == w.create_ns
+    assert back.trace_id == w.trace_id
+    assert back.to_dict() == w.to_dict()
+    # gojson (through real JSON bytes, like the TCP relay)
+    w2 = WireEvent.from_json_obj(json.loads(
+        json.dumps(w.to_dict(), default=_b64)))
+    assert w2.create_ns == w.create_ns
+    assert w2.to_dict() == w.to_dict()
+
+
+def _b64(obj):
+    import base64
+
+    if isinstance(obj, (bytes, bytearray)):
+        return base64.b64encode(bytes(obj)).decode()
+    raise TypeError
+
+
+def test_payload_nbytes_columnar_is_exact():
+    cols = ColumnarEvents.from_wire_events(
+        [_wire_event(create_ns=5, trace_id=9),
+         _wire_event(idx=2, txs=(b"abc", b""))])
+    assert cols.nbytes() == len(cols.encode())
+    # legacy estimate: positive and roughly envelope-sized
+    est = wire_payload_nbytes([_wire_event()])
+    assert 200 < est < 600
+
+
+def test_mixed_stamped_cluster_commits_byte_identical_blocks(monkeypatch):
+    """Stamped vs unstamped, columnar vs legacy, any mix: consensus
+    output is byte-identical — the sidecar never leaks into the DAG.
+    Propagation latency is observed on the stamped runs."""
+    tick = {"ns": 1_700_000_000_000_000_000}
+
+    def fake_now():
+        tick["ns"] += 1_000_000
+        return Timestamp(tick["ns"])
+
+    monkeypatch.setattr(gojson.Timestamp, "now", staticmethod(fake_now))
+
+    def run(wire_formats, clock):
+        tick["ns"] = 1_700_000_000_000_000_000
+        cores = _three_cores(clock=clock)
+        before = sum(c._m_propagation.count for c in cores
+                     if c._m_propagation is not None)
+        blocks = [[] for _ in cores]
+        for i, c in enumerate(cores):
+            c._commit_callback = blocks[i].append
+            c.hg.commit_callback = blocks[i].append
+        script = [(0, 1), (1, 2), (2, 0), (1, 0), (0, 2), (2, 1)] * 10
+        for i, (dst, src) in enumerate(script):
+            diff = cores[src].diff(cores[dst].known())
+            payload = cores[src].to_wire_batch(diff, wire_formats[src])
+            cores[dst].add_transactions([b"tx %d" % i])
+            cores[dst].sync(payload)
+            cores[dst].run_consensus()
+        out = []
+        for blist in blocks:
+            out.append([json.dumps(
+                {"r": b.round_received,
+                 "txs": [t.hex() for t in (b.transactions or [])]},
+                sort_keys=True) for b in blist])
+        prop = sum(c._m_propagation.count for c in cores
+                   if c._m_propagation is not None) - before
+        return out, prop
+
+    unstamped, p0 = run(["columnar"] * 3, clock=False)
+    stamped_col, p1 = run(["columnar"] * 3, clock=True)
+    stamped_mix, p2 = run(["columnar", "gojson", "columnar"], clock=True)
+    assert unstamped == stamped_col == stamped_mix
+    assert p0 == 0  # no clocks, no stamps, no samples
+    assert p1 > 0 and p2 > 0  # stamped runs observed real latencies
+
+
+# ------------------------------------------------------- live node plane
+
+
+def _make_net(n=3, heartbeat=0.01, observatory=True, **faults):
+    inner = [InmemTransport(f"addr{i}", timeout=2.0) for i in range(n)]
+    connect_all(inner)
+    if faults:
+        trans = {t.local_addr(): FaultyTransport(t, seed=11, **faults)
+                 for t in inner}
+    else:
+        trans = {t.local_addr(): t for t in inner}
+    entries = make_keyed_peers(n, addr_fn=lambda i: f"addr{i}")
+    peers = [p for _, p in entries]
+    participants = {p.pub_key_hex: i for i, p in enumerate(peers)}
+    nodes = []
+    for i, (key, peer) in enumerate(entries):
+        conf = fast_config(heartbeat=heartbeat)
+        conf.gossip_observatory = observatory
+        store = InmemStore(participants, CACHE)
+        nodes.append(Node(conf, i, key, peers, store,
+                          trans[peer.net_addr], InmemAppProxy()))
+        nodes[-1].init()
+    return nodes
+
+
+def _run_until_round(nodes, target_round=3, timeout=60.0):
+    for nd in nodes:
+        nd.run_async(gossip=True)
+    deadline = time.monotonic() + timeout
+    i = 0
+    while time.monotonic() < deadline:
+        nodes[i % len(nodes)].submit_tx(b"gtx %d" % i)
+        i += 1
+        if all((nd.core.get_last_consensus_round_index() or 0)
+               >= target_round for nd in nodes):
+            return
+        time.sleep(0.02)
+    raise AssertionError("net never reached the target round")
+
+
+def test_node_accounting_and_debug_endpoints():
+    from babble_tpu.service import Service
+    from babble_tpu.telemetry import promtext
+
+    nodes = _make_net()
+    svc = Service("127.0.0.1:0", nodes[0])
+    svc.serve_async()
+    try:
+        _run_until_round(nodes)
+        nd = nodes[0]
+        agg = {k: c.value for k, c in nd._m_gossip_agg.items()}
+        assert agg["offered"] > 0 and agg["new"] > 0
+        assert agg["syncs"] > 0 and agg["bytes"] > 0
+        # classification identity: every offered event lands in
+        # exactly one bucket
+        assert agg["offered"] == agg["new"] + agg["duplicate"] \
+            + agg["stale"]
+        # propagation latency observed for remote stamped events
+        assert nd.core._m_propagation.count > 0
+
+        # /debug/gossip: efficiency table with per-peer legs + totals
+        with urllib.request.urlopen(
+                f"http://{svc.addr}/debug/gossip", timeout=10) as r:
+            gdbg = json.loads(r.read())
+        assert gdbg["totals"]["offered"] == int(agg["offered"])
+        assert gdbg["peers"]
+        peer, legs = next(iter(gdbg["peers"].items()))
+        assert "totals" in legs
+        assert "redundancy_ratio" in legs["totals"]
+        assert "bytes_per_new_event" in legs["totals"]
+        assert "propagation_ms" in gdbg
+        assert gdbg["known_bookkeeping"]["calls"] > 0
+
+        # /debug/peers: the efficiency columns joined onto peer health
+        with urllib.request.urlopen(
+                f"http://{svc.addr}/debug/peers", timeout=10) as r:
+            pdbg = json.loads(r.read())
+        row = next(iter(pdbg["peers"].values()))
+        assert "redundancy_ratio" in row
+        assert "bytes_per_new_event" in row
+
+        # /metrics: the families a Prometheus scrape must see
+        with urllib.request.urlopen(
+                f"http://{svc.addr}/metrics", timeout=10) as r:
+            samples, _ = promtext.parse(r.read().decode())
+        for fam in ("babble_gossip_offered_events_total",
+                    "babble_gossip_new_events_total",
+                    "babble_gossip_duplicate_events_total",
+                    "babble_gossip_syncs_total",
+                    "babble_gossip_payload_bytes_total",
+                    "babble_propagation_latency_seconds"):
+            assert any(fam in s for s in samples), fam
+        # per-peer children carry peer+leg labels
+        labeled = [lb for lb, v in
+                   samples["babble_gossip_offered_events_total"]
+                   if "peer" in lb]
+        assert any(lb.get("leg") in ("pull", "push_in")
+                   for lb in labeled)
+    finally:
+        for nd in nodes:
+            nd.shutdown()
+        svc.close()
+    check_gossip(nodes)
+
+
+def test_duplicate_push_injection_feeds_duplicate_counter():
+    """Satellite: the chaos transport's at-least-once duplicate
+    delivery must be VISIBLE in the new accounting — every injected
+    duplicate push re-offers an already-present batch."""
+    nodes = _make_net(duplicate=1.0)
+    try:
+        _run_until_round(nodes, target_round=2)
+    finally:
+        for nd in nodes:
+            nd.shutdown()
+    injected = sum(nd.trans.injected["duplicate"] for nd in nodes)
+    assert injected > 0
+    dup = sum(nd._m_gossip_agg["duplicate"].value for nd in nodes)
+    assert dup > 0, "injected duplicate pushes never hit the counter"
+    # and specifically on the push_in leg of some node
+    push_dup = sum(
+        ch["duplicate"].value
+        for nd in nodes
+        for (peer, leg), ch in nd._gossip_children.items()
+        if leg == "push_in")
+    assert push_dup > 0
+
+
+def test_observatory_off_disables_everything():
+    nodes = _make_net(observatory=False)
+    try:
+        _run_until_round(nodes, target_round=2)
+        nd = nodes[0]
+        assert nd._m_gossip_agg == {}
+        assert nd._gossip_children == {}
+        assert nd.get_gossip_stats() == {"enabled": False}
+        assert nd.gossip_peer_efficiency() == {}
+        assert nd.core._m_propagation is None
+        # no stamps ⇒ the wire form stays byte-identical to legacy
+        head = nd.core.get_head()
+        assert head.create_ns == 0
+        assert "_CreateNs" not in head.to_wire().to_dict()
+        # and the known phase timer never ran
+        assert "known" not in nd.core.phase_ns
+    finally:
+        for nd in nodes:
+            nd.shutdown()
+    check_gossip(nodes)
+
+
+# ------------------------------------------------- bench_compare shapes
+
+
+def test_bench_compare_gates_soak_ratio_unnormalized():
+    import bench_compare as bc
+
+    base = {"metric": "gossip_soak", "host_events_per_s": 1000.0,
+            "soak16_events_per_s": 100.0,
+            "soak16_redundancy_ratio": 2.0,
+            "soak16_propagation_p99_ms": 50.0}
+    # Fresh runner is 2x faster — the ratio must NOT be scaled by the
+    # yardstick, so a 50% redundancy jump is a regression even though
+    # every throughput number doubled.
+    fresh = {"metric": "gossip_soak", "host_events_per_s": 2000.0,
+             "soak16_events_per_s": 200.0,
+             "soak16_redundancy_ratio": 3.0,
+             "soak16_propagation_p99_ms": 25.0}
+    rows = {r["key"]: r for r in bc.compare(fresh, base, 0.10)}
+    assert rows["soak16_events_per_s"]["status"] in ("ok", "improved")
+    assert rows["soak16_redundancy_ratio"]["status"] == "REGRESSION"
+    assert rows["soak16_redundancy_ratio"]["expected"] == 2.0
+    # improvement never fails
+    fresh["soak16_redundancy_ratio"] = 1.5
+    rows = {r["key"]: r for r in bc.compare(fresh, base, 0.10)}
+    assert rows["soak16_redundancy_ratio"]["status"] == "improved"
+    # info kinds never gate
+    base["soak16_coverage_ms"] = 10.0
+    fresh["soak16_coverage_ms"] = 500.0
+    rows = {r["key"]: r for r in bc.compare(fresh, base, 0.10)}
+    assert rows["soak16_coverage_ms"]["status"] == "info"
