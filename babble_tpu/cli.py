@@ -124,6 +124,11 @@ def cmd_run(args) -> int:
         wire_format=args.wire_format,
         max_msg_bytes=args.max_msg_bytes << 20,
         compile_cache_dir=args.compile_cache_dir,
+        plumtree=not args.no_plumtree,
+        eager_fanout=args.eager_fanout,
+        ihave_interval=args.ihave_interval / 1000.0,
+        graft_timeout=args.graft_timeout / 1000.0,
+        anti_entropy_interval=args.anti_entropy_interval / 1000.0,
         logger=logger,
     )
 
@@ -329,6 +334,26 @@ def build_parser() -> argparse.ArgumentParser:
                          "per peer with transparent fallback; gojson = "
                          "the reference's per-event JSON dicts (both "
                          "forms are always accepted inbound)")
+    rn.add_argument("--no_plumtree", action="store_true",
+                    help="disable the epidemic broadcast tree "
+                         "(docs/gossip.md) and restore the reference's "
+                         "pull-only random gossip: no eager push, no "
+                         "IHAVE/GRAFT/PRUNE, the heartbeat loop pulls "
+                         "every tick")
+    rn.add_argument("--eager_fanout", type=int, default=0,
+                    help="eager push fan-out (tree degree); 0 = auto "
+                         "(~log2(n), capped at 4)")
+    rn.add_argument("--ihave_interval", type=int, default=250,
+                    help="milliseconds between coalesced IHAVE digest "
+                         "announcements to lazy peers")
+    rn.add_argument("--graft_timeout", type=int, default=350,
+                    help="milliseconds a digest-announced event may "
+                         "stay missing before GRAFTing it from an "
+                         "announcer (promoting that edge to eager)")
+    rn.add_argument("--anti_entropy_interval", type=int, default=1000,
+                    help="milliseconds between anti-entropy pull "
+                         "rounds while plumtree is on (the known-map "
+                         "SyncRequest backstop)")
     rn.add_argument("--max_msg_bytes", type=int, default=32,
                     help="cap on a single gossip RPC message in MiB "
                          "(JSON line or binary frame, either "

@@ -15,6 +15,12 @@ from .transport import (
     SyncResponse,
     EagerSyncRequest,
     EagerSyncResponse,
+    IHaveRequest,
+    IHaveResponse,
+    GraftRequest,
+    GraftResponse,
+    PruneRequest,
+    PruneResponse,
     Transport,
     TransportError,
 )
@@ -34,6 +40,12 @@ __all__ = [
     "SyncResponse",
     "EagerSyncRequest",
     "EagerSyncResponse",
+    "IHaveRequest",
+    "IHaveResponse",
+    "GraftRequest",
+    "GraftResponse",
+    "PruneRequest",
+    "PruneResponse",
     "Transport",
     "TransportError",
     "FaultSpec",

@@ -85,6 +85,72 @@ def wire_payload_nbytes(events) -> int:
     return size
 
 
+DIGEST_MAGIC = b"BBD1"
+# (creator_id int32, index int32, sha256 hash 32B) per digest row.
+_DIGEST_ROW = 4 + 4 + 32
+
+
+class ColumnarDigests:
+    """Packed IHAVE digest batch (docs/gossip.md): one int32 column per
+    wire coordinate plus the raw 32-byte event hashes, so a lazy-peer
+    announcement costs 40 bytes per event instead of a Go-JSON list
+    entry. The in-process transports pass the object by reference; the
+    TCP transport ships `encode()` as a binary frame."""
+
+    __slots__ = ("cid", "idx", "hashes")
+
+    def __init__(self, cid, idx, hashes: bytes):
+        self.cid = cid
+        self.idx = idx
+        self.hashes = hashes  # 32 bytes per digest, concatenated
+
+    def __len__(self) -> int:
+        return len(self.cid)
+
+    @classmethod
+    def from_list(cls, digests) -> "ColumnarDigests":
+        """From [(creator_id, index, event_hex), ...] — event_hex is the
+        store key form ("0x" + 64 hex chars)."""
+        cid = [c for c, _, _ in digests]
+        idx = [i for _, i, _ in digests]
+        hashes = b"".join(bytes.fromhex(h[2:]) for _, _, h in digests)
+        return cls(np.asarray(cid, np.int32), np.asarray(idx, np.int32),
+                   hashes)
+
+    def to_list(self):
+        cid = self.cid.tolist()
+        idx = self.idx.tolist()
+        return [(cid[k], idx[k],
+                 "0x" + self.hashes[32 * k:32 * k + 32].hex().upper())
+                for k in range(len(cid))]
+
+    def nbytes(self) -> int:
+        return 4 + 4 + _DIGEST_ROW * len(self)
+
+    def encode(self) -> bytes:
+        n = len(self)
+        return b"".join((
+            DIGEST_MAGIC, struct.pack("<I", n),
+            np.ascontiguousarray(self.cid, "<i4").tobytes(),
+            np.ascontiguousarray(self.idx, "<i4").tobytes(),
+            self.hashes,
+        ))
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "ColumnarDigests":
+        if len(buf) < 8 or buf[:4] != DIGEST_MAGIC:
+            raise WireFormatError("bad columnar digest header")
+        (n,) = struct.unpack_from("<I", buf, 4)
+        if len(buf) != 8 + _DIGEST_ROW * n:
+            raise WireFormatError(
+                f"digest frame length {len(buf)} != expected "
+                f"{8 + _DIGEST_ROW * n}")
+        cid = np.frombuffer(buf, "<i4", n, 8)
+        idx = np.frombuffer(buf, "<i4", n, 8 + 4 * n)
+        hashes = buf[8 + 8 * n:]
+        return cls(cid, idx, hashes)
+
+
 class ColumnarEvents:
     """One sync batch, one contiguous array per field."""
 

@@ -48,6 +48,12 @@ from .transport import (
     EagerSyncResponse,
     FastForwardRequest,
     FastForwardResponse,
+    GraftRequest,
+    GraftResponse,
+    IHaveRequest,
+    IHaveResponse,
+    PruneRequest,
+    PruneResponse,
     SyncRequest,
     SyncResponse,
     TransportError,
@@ -244,6 +250,28 @@ class FaultyTransport:
                 target, EagerSyncRequest(from_id, picked))
         except TransportError:
             pass
+
+    def ihave(self, target: str, args: IHaveRequest) -> IHaveResponse:
+        # The lazy-repair announcements ride the same fault plan as the
+        # data legs: dropped IHAVEs are exactly the loss mode the
+        # anti-entropy backstop must absorb (docs/gossip.md).
+        spec, rng = self._apply(target)
+        resp = self._inner.ihave(target, args)
+        if spec.duplicate > 0.0 and rng.random() < spec.duplicate:
+            self._inject("duplicate")
+            try:
+                self._inner.ihave(target, args)
+            except TransportError:
+                pass
+        return resp
+
+    def graft(self, target: str, args: GraftRequest) -> GraftResponse:
+        self._apply(target)
+        return self._inner.graft(target, args)
+
+    def prune(self, target: str, args: PruneRequest) -> PruneResponse:
+        self._apply(target)
+        return self._inner.prune(target, args)
 
     def fast_forward(self, target: str,
                      args: FastForwardRequest) -> FastForwardResponse:

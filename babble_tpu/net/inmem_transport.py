@@ -13,6 +13,12 @@ import uuid
 from .transport import (
     FastForwardRequest,
     FastForwardResponse,
+    GraftRequest,
+    GraftResponse,
+    IHaveRequest,
+    IHaveResponse,
+    PruneRequest,
+    PruneResponse,
     RPC,
     EagerSyncRequest,
     EagerSyncResponse,
@@ -50,6 +56,24 @@ class InmemTransport:
     def eager_sync(self, target: str, args: EagerSyncRequest) -> EagerSyncResponse:
         resp = self._make_rpc(target, args)
         if not isinstance(resp, EagerSyncResponse):
+            raise TransportError(f"unexpected response type {type(resp)}")
+        return resp
+
+    def ihave(self, target: str, args: IHaveRequest) -> IHaveResponse:
+        resp = self._make_rpc(target, args)
+        if not isinstance(resp, IHaveResponse):
+            raise TransportError(f"unexpected response type {type(resp)}")
+        return resp
+
+    def graft(self, target: str, args: GraftRequest) -> GraftResponse:
+        resp = self._make_rpc(target, args)
+        if not isinstance(resp, GraftResponse):
+            raise TransportError(f"unexpected response type {type(resp)}")
+        return resp
+
+    def prune(self, target: str, args: PruneRequest) -> PruneResponse:
+        resp = self._make_rpc(target, args)
+        if not isinstance(resp, PruneResponse):
             raise TransportError(f"unexpected response type {type(resp)}")
         return resp
 

@@ -46,10 +46,16 @@ import threading
 from typing import Dict, List, Optional
 
 from ..telemetry import get_registry
-from .columnar import ColumnarEvents, WIRE_VERSION
+from .columnar import ColumnarDigests, ColumnarEvents, WIRE_VERSION
 from .transport import (
     FastForwardRequest,
     FastForwardResponse,
+    GraftRequest,
+    GraftResponse,
+    IHaveRequest,
+    IHaveResponse,
+    PruneRequest,
+    PruneResponse,
     RPC,
     EagerSyncRequest,
     EagerSyncResponse,
@@ -64,6 +70,14 @@ RPC_EAGER_SYNC = 0x01
 RPC_FAST_FORWARD = 0x02
 RPC_SYNC_COL = 0x03
 RPC_EAGER_SYNC_COL = 0x04
+# Plumtree lazy-repair plane (docs/gossip.md): IHAVE digests, GRAFT
+# pulls, PRUNE demotions. The *_COL variants move the payload as a
+# length-prefixed binary frame when the peer negotiated columnar.
+RPC_IHAVE = 0x05
+RPC_IHAVE_COL = 0x06
+RPC_GRAFT = 0x07
+RPC_GRAFT_COL = 0x08
+RPC_PRUNE = 0x09
 RPC_WIRE_HELLO = 0x7E
 
 DEFAULT_MAX_MSG_BYTES = 32 << 20
@@ -181,7 +195,10 @@ def _pack_eager_request(req: EagerSyncRequest) -> bytes:
     events = req.events
     if isinstance(events, list):
         events = ColumnarEvents.from_wire_events(events)
-    hb = json.dumps({"FromID": req.from_id}).encode()
+    header = {"FromID": req.from_id}
+    if req.plum:
+        header["Plum"] = True
+    hb = json.dumps(header).encode()
     return struct.pack(">I", len(hb)) + hb + events.encode()
 
 
@@ -193,7 +210,49 @@ def _unpack_eager_request(buf: bytes) -> EagerSyncRequest:
     return EagerSyncRequest(
         from_id=header["FromID"],
         events=ColumnarEvents.decode(buf[4 + hlen:]),
+        plum=bool(header.get("Plum", False)),
     )
+
+
+def _pack_ihave_request(req: IHaveRequest) -> bytes:
+    digests = req.digests
+    if isinstance(digests, list):
+        digests = ColumnarDigests.from_list(digests)
+    hb = json.dumps({"FromID": req.from_id}).encode()
+    return struct.pack(">I", len(hb)) + hb + digests.encode()
+
+
+def _unpack_ihave_request(buf: bytes) -> IHaveRequest:
+    if len(buf) < 4:
+        raise TransportError("short columnar ihave request")
+    (hlen,) = struct.unpack_from(">I", buf)
+    header = json.loads(buf[4:4 + hlen])
+    return IHaveRequest(
+        from_id=header["FromID"],
+        digests=ColumnarDigests.decode(buf[4 + hlen:]),
+    )
+
+
+def _pack_graft_response(resp: GraftResponse) -> bytes:
+    events = resp.events
+    if isinstance(events, list):
+        events = ColumnarEvents.from_wire_events(events)
+    hb = json.dumps({"FromID": resp.from_id,
+                     "SyncLimit": resp.sync_limit}).encode()
+    return struct.pack(">I", len(hb)) + hb + events.encode()
+
+
+def _unpack_graft_response(buf: bytes) -> GraftResponse:
+    if len(buf) < 4:
+        raise TransportError("short columnar graft response")
+    (hlen,) = struct.unpack_from(">I", buf)
+    header = json.loads(buf[4:4 + hlen])
+    resp = GraftResponse(
+        from_id=header["FromID"],
+        sync_limit=header.get("SyncLimit", False),
+    )
+    resp.events = ColumnarEvents.decode(buf[4 + hlen:])
+    return resp
 
 
 class TCPTransport:
@@ -285,6 +344,31 @@ class TCPTransport:
         # Legacy peer: downconvert a columnar payload transparently.
         out = self._generic_rpc(target, RPC_EAGER_SYNC, args.to_dict())
         return EagerSyncResponse.from_dict(out)
+
+    def ihave(self, target: str, args: IHaveRequest) -> IHaveResponse:
+        if self._use_columnar(target):
+            out = self._frame_request_rpc(
+                target, RPC_IHAVE_COL, _pack_ihave_request(args))
+            return IHaveResponse.from_dict(out)
+        out = self._generic_rpc(target, RPC_IHAVE, args.to_dict())
+        return IHaveResponse.from_dict(out)
+
+    def graft(self, target: str, args: GraftRequest) -> GraftResponse:
+        if self._use_columnar(target):
+            frame = self._frame_response_rpc(
+                target, RPC_GRAFT_COL, args.to_dict())
+            try:
+                return _unpack_graft_response(frame)
+            except (ValueError, KeyError) as exc:
+                raise TransportError(
+                    f"malformed columnar graft response from {target}: "
+                    f"{exc}") from exc
+        out = self._generic_rpc(target, RPC_GRAFT, args.to_dict())
+        return GraftResponse.from_dict(out)
+
+    def prune(self, target: str, args: PruneRequest) -> PruneResponse:
+        out = self._generic_rpc(target, RPC_PRUNE, args.to_dict())
+        return PruneResponse.from_dict(out)
 
     def fast_forward(self, target: str,
                      args: FastForwardRequest) -> FastForwardResponse:
@@ -396,10 +480,20 @@ class TCPTransport:
 
     def _columnar_eager_rpc(self, target: str,
                             args: EagerSyncRequest) -> dict:
-        frame = _pack_eager_request(args)
+        return self._frame_request_rpc(
+            target, RPC_EAGER_SYNC_COL, _pack_eager_request(args))
+
+    def _frame_request_rpc(self, target: str, rpc_type: int,
+                           frame: bytes) -> dict:
+        """Binary request frame -> JSON error line + JSON response (the
+        EagerSyncColumnar / IHaveColumnar shape)."""
+        if len(frame) > self._max_msg_bytes:
+            raise TransportError(
+                f"frame of {len(frame)} bytes exceeds max_msg_bytes "
+                f"({self._max_msg_bytes})")
         conn = self._get_conn(target)
         try:
-            conn.sock.sendall(bytes([RPC_EAGER_SYNC_COL]))
+            conn.sock.sendall(bytes([rpc_type]))
             conn.send_frame(frame)
             rpc_error = conn.recv_json()
             resp = conn.recv_json()
@@ -411,6 +505,25 @@ class TCPTransport:
             raise TransportError(f"rpc error: {rpc_error}")
         self._return_conn(target, conn)
         return resp
+
+    def _frame_response_rpc(self, target: str, rpc_type: int,
+                            body: dict) -> bytes:
+        """JSON request line -> JSON error line + binary response frame
+        (the SyncColumnar / GraftColumnar shape)."""
+        conn = self._get_conn(target)
+        try:
+            conn.sock.sendall(bytes([rpc_type]))
+            conn.send_json(body)
+            rpc_error = conn.recv_json()
+            frame = conn.recv_frame() if not rpc_error else b""
+        except (OSError, ValueError, TransportError) as exc:
+            conn.close()
+            raise TransportError(f"rpc to {target} failed: {exc}") from exc
+        if rpc_error:
+            conn.close()
+            raise TransportError(f"rpc error: {rpc_error}")
+        self._return_conn(target, conn)
+        return frame
 
     # -- inbound -----------------------------------------------------------
 
@@ -451,6 +564,17 @@ class TCPTransport:
                     cmd = EagerSyncRequest.from_dict(conn.recv_json())
                 elif t[0] == RPC_EAGER_SYNC_COL:
                     cmd = _unpack_eager_request(conn.recv_frame())
+                elif t[0] == RPC_IHAVE:
+                    cmd = IHaveRequest.from_dict(conn.recv_json())
+                elif t[0] == RPC_IHAVE_COL:
+                    cmd = _unpack_ihave_request(conn.recv_frame())
+                elif t[0] == RPC_GRAFT:
+                    cmd = GraftRequest.from_dict(conn.recv_json())
+                elif t[0] == RPC_GRAFT_COL:
+                    cmd = GraftRequest.from_dict(conn.recv_json())
+                    wire = "columnar_graft"
+                elif t[0] == RPC_PRUNE:
+                    cmd = PruneRequest.from_dict(conn.recv_json())
                 elif t[0] == RPC_FAST_FORWARD:
                     cmd = FastForwardRequest.from_dict(conn.recv_json())
                 else:
@@ -480,6 +604,11 @@ class TCPTransport:
                     if err:
                         continue
                     conn.send_frame(_pack_sync_response(payload))
+                elif wire == "columnar_graft":
+                    conn.send_json(err)
+                    if err:
+                        continue
+                    conn.send_frame(_pack_graft_response(payload))
                 else:
                     conn.send_json(err)
                     conn.send_json(
@@ -491,5 +620,5 @@ class TCPTransport:
 
     def _respond_error(self, conn: _Conn, wire: str, msg: str) -> None:
         conn.send_json(msg)
-        if wire != "columnar":
+        if not wire.startswith("columnar"):
             conn.send_json({})

@@ -122,21 +122,33 @@ class EagerSyncRequest:
     from_id: int
     # Legacy List[WireEvent] or a packed ColumnarEvents batch.
     events: object = field(default_factory=list)
+    # Plumtree eager-push marker (docs/gossip.md): True when this push
+    # is an epidemic-broadcast tree edge rather than the reference's
+    # round-trailing push — the receiver uses it to pick the `eager`
+    # accounting leg and to answer redundant edges with PRUNE. Same
+    # sidecar contract as the clock stamps: rides the dict only when
+    # set, so the legacy wire form is byte-identical and legacy
+    # decoders ignore the extra key.
+    plum: bool = False
 
     def to_dict(self) -> dict:
         events = self.events
         if not isinstance(events, list):
             events = events.to_wire_events()
-        return {
+        d = {
             "FromID": self.from_id,
             "Events": [e.to_dict() for e in events],
         }
+        if self.plum:
+            d["Plum"] = True
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "EagerSyncRequest":
         return cls(
             from_id=d["FromID"],
             events=[WireEvent.from_json_obj(e) for e in (d.get("Events") or [])],
+            plum=bool(d.get("Plum", False)),
         )
 
 
@@ -151,6 +163,141 @@ class EagerSyncResponse:
     @classmethod
     def from_dict(cls, d: dict) -> "EagerSyncResponse":
         return cls(from_id=d["FromID"], success=d.get("Success", False))
+
+
+# -- epidemic broadcast tree RPCs (docs/gossip.md) -----------------------
+#
+# Plumtree lazy-repair plane: IHAVE announces fresh events to lazy
+# peers as compact digests (event hash + creator/index coordinates),
+# GRAFT pulls a gap from a peer and promotes the edge to eager, PRUNE
+# demotes a redundant eager edge back to lazy. None of these exist in
+# the reference (its gossip is pull-only); all three follow the sidecar
+# discipline of the other extensions — plain Go-style JSON dicts, no
+# signed bodies, and a request-matching response type even on errors
+# (the PR 2 not-ready rule).
+
+
+@dataclass
+class IHaveRequest:
+    """Digest announcement to a lazy peer. Digests are
+    (creator_id, index, event_hex) triples — enough for the receiver to
+    check its store, dedupe announcers, and name the exact gap in a
+    GRAFT. `digests` may also arrive as a packed `ColumnarDigests`
+    (net/columnar.py) on the binary TCP framing."""
+
+    from_id: int
+    digests: object = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        digests = self.digests
+        if not isinstance(digests, list):
+            digests = digests.to_list()
+        return {
+            "FromID": self.from_id,
+            "Digests": [[c, i, h] for (c, i, h) in digests],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "IHaveRequest":
+        return cls(
+            from_id=d["FromID"],
+            digests=[(int(c), int(i), str(h))
+                     for c, i, h in (d.get("Digests") or [])],
+        )
+
+
+@dataclass
+class IHaveResponse:
+    from_id: int
+    success: bool = True
+
+    def to_dict(self) -> dict:
+        return {"FromID": self.from_id, "Success": self.success}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "IHaveResponse":
+        return cls(from_id=d["FromID"], success=d.get("Success", True))
+
+
+@dataclass
+class GraftRequest:
+    """Lazy pull + eager promotion: 'send me what I'm missing and keep
+    me on your eager set'. Carries the requester's known map so the
+    responder serves an exact diff (the missing event AND its
+    not-yet-seen ancestors, which a hash-only pull could not name)."""
+
+    from_id: int
+    known: Dict[int, int] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"FromID": self.from_id,
+                "Known": {str(k): v for k, v in self.known.items()}}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "GraftRequest":
+        return cls(
+            from_id=d["FromID"],
+            known={int(k): v for k, v in (d.get("Known") or {}).items()},
+        )
+
+
+@dataclass
+class GraftResponse:
+    from_id: int
+    # Legacy List[WireEvent] or a packed ColumnarEvents batch, exactly
+    # like SyncResponse.events.
+    events: object = field(default_factory=list)
+    # True when the requester is too far behind for a bounded diff
+    # (same semantics as SyncResponse.sync_limit): fast-sync instead.
+    sync_limit: bool = False
+
+    def to_dict(self) -> dict:
+        events = self.events
+        if not isinstance(events, list):
+            events = events.to_wire_events()
+        return {
+            "FromID": self.from_id,
+            "SyncLimit": self.sync_limit,
+            "Events": [e.to_dict() for e in events],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "GraftResponse":
+        return cls(
+            from_id=d["FromID"],
+            sync_limit=d.get("SyncLimit", False),
+            events=[WireEvent.from_json_obj(e)
+                    for e in (d.get("Events") or [])],
+        )
+
+
+@dataclass
+class PruneRequest:
+    """'Stop eager-pushing at me — I already had that': demote the
+    sender->receiver tree edge to lazy (IHAVE digests keep flowing, so
+    the edge still repairs losses)."""
+
+    from_id: int
+
+    def to_dict(self) -> dict:
+        return {"FromID": self.from_id}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PruneRequest":
+        return cls(from_id=d["FromID"])
+
+
+@dataclass
+class PruneResponse:
+    from_id: int
+    success: bool = True
+
+    def to_dict(self) -> dict:
+        return {"FromID": self.from_id, "Success": self.success}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PruneResponse":
+        return cls(from_id=d["FromID"], success=d.get("Success", True))
 
 
 @dataclass
@@ -233,6 +380,12 @@ class Transport(Protocol):
     def sync(self, target: str, args: SyncRequest) -> SyncResponse: ...
 
     def eager_sync(self, target: str, args: EagerSyncRequest) -> EagerSyncResponse: ...
+
+    def ihave(self, target: str, args: IHaveRequest) -> IHaveResponse: ...
+
+    def graft(self, target: str, args: GraftRequest) -> GraftResponse: ...
+
+    def prune(self, target: str, args: PruneRequest) -> PruneResponse: ...
 
     def fast_forward(
         self, target: str, args: FastForwardRequest

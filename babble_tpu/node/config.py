@@ -38,6 +38,52 @@ class Config:
     # columnar frame, either direction): a misbehaving peer hits a
     # clear TransportError instead of growing an unbounded buffer.
     max_msg_bytes: int = 32 << 20
+    # -- epidemic broadcast tree (docs/gossip.md) ----------------------
+    # Plumtree-style two-tier dissemination: fresh events (own
+    # self-events and first-seen inserts) are eager-pushed immediately
+    # along a per-node set of eager peers forming a lazily-repaired
+    # spanning tree; the remaining (lazy) peers receive compact IHAVE
+    # digests and pull gaps via GRAFT (which promotes the edge back to
+    # eager), while duplicate eager deliveries answer with PRUNE
+    # (demoting the redundant edge). The periodic pull loop stays on as
+    # a low-frequency anti-entropy backstop. False restores the
+    # reference's pull-only random gossip byte-for-byte (--no_plumtree
+    # kill switch): no tree state, no IHAVE/GRAFT/PRUNE RPCs, the
+    # heartbeat loop pulls every tick.
+    plumtree: bool = True
+    # Eager fan-out: how many peers this node pushes fresh events to.
+    # 0 = auto (~log2(n), capped at 4 — enough for an O(log n)-depth
+    # tree whose union is connected w.h.p. while keeping the pre-prune
+    # redundancy bounded).
+    eager_fanout: int = 0
+    # Min seconds between eager pushes to ONE peer: the coalescing
+    # window that batches cascade relays instead of sending one RPC
+    # per event. 0 = auto (heartbeat_timeout capped at 25 ms, so a
+    # production 1 s heartbeat still propagates in ~25 ms hops).
+    eager_push_interval: float = 0.0
+    # Per-peer in-flight window for eager pushes: at most this many
+    # outstanding push RPCs per peer; beyond it fresh events buffer
+    # (bounded) and a consistently-full peer is shed to lazy instead
+    # of queueing behind it.
+    plumtree_inflight: int = 2
+    # Seconds between IHAVE digest announcements to lazy peers
+    # (digests coalesce across the interval; chunked under
+    # max_msg_bytes).
+    ihave_interval: float = 0.25
+    # Seconds a digest-announced event may stay missing before the
+    # node GRAFTs it from an announcer (promoting that edge to eager).
+    # The timer is what lets the eager path deliver first — a GRAFT
+    # only fires for genuine tree holes.
+    graft_timeout: float = 0.35
+    # Seconds between anti-entropy pull rounds while plumtree is on
+    # (the known-map SyncRequest loop of the reference, demoted to a
+    # low-cadence backstop that catches anything the tree and the
+    # IHAVE plane both lost). Known-map pulls are exact diffs — the
+    # legacy redundancy came from the round-trailing PUSH leg, which
+    # plumtree removes — so a sub-second backstop stays cheap while
+    # bounding worst-case delivery latency when the eager plane sheds
+    # under load.
+    anti_entropy_interval: float = 0.25
     # Consensus engine: "host" (incremental reference-semantics Python)
     # or "tpu" (batched device pipeline behind the same seam).
     engine: str = "host"

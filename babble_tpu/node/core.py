@@ -169,6 +169,39 @@ class Core:
         # byte-identical to the pre-observatory encoding.
         self.clock = clock
         self._observatory = bool(gossip_observatory)
+        # Epidemic broadcast hook (node/plumtree.py, docs/gossip.md):
+        # called (under the owner's core lock) with every list of
+        # freshly-INSERTED events — first-seen remote inserts and this
+        # node's own self-events, in insertion order — so the owning
+        # node can eager-push them along its tree edges. None (bare
+        # Core, plumtree off) costs one falsy check per batch.
+        self.fresh_observer = None
+        # Wrap pacing (docs/gossip.md, plumtree legs only): minimum
+        # seconds between this node's wrap self-events. 0 = wrap per
+        # sync (reference behavior). The owning node raises it with
+        # measured congestion (pull RTT), so a saturated cluster
+        # batches many syncs/txs into ONE wrap instead of minting an
+        # event per sync — at n=32 on one core, per-sync wraps alone
+        # produce more ECDSA work than the machine has (every node
+        # verifies every event), and consensus never catches up.
+        self.wrap_min_interval = 0.0
+        self._last_wrap_ts = 0.0
+        # Dedup-before-verify (ROADMAP crypto-plane lever 1): hashes of
+        # events currently in another batch's unlocked verify window.
+        # A concurrent batch offering the same event skips its ECDSA
+        # check — the insert loop's has_event/memo re-check keeps
+        # correctness — so a duplicate costs a set lookup, not ~98 us
+        # of libcrypto. Mutated only under the owner's core lock.
+        self._verify_inflight: set = set()
+        # Events actually submitted to ECDSA verification (the number
+        # the dedup-before-verify satellite drives DOWN; duplicates
+        # never reach it). Exposed as a counter so the duplicate-
+        # injection test can assert verify work ~= new events.
+        self._m_verified = self._registry.counter(
+            "babble_verify_events_total",
+            "Events submitted to ECDSA signature verification "
+            "(duplicates are deduped before verify)",
+            node=self._node_label)
         self._m_propagation = (
             self._registry.histogram(
                 "babble_propagation_latency_seconds",
@@ -304,6 +337,9 @@ class Core:
                 time.perf_counter_ns())
         event.sign(self.key)
         self.insert_event(event, True)
+        self._last_wrap_ts = time.monotonic()
+        if self.fresh_observer is not None:
+            self.fresh_observer([event])
 
     def insert_event(self, event: Event, set_wire_info: bool) -> None:
         self.hg.insert_event(event, set_wire_info)
@@ -365,7 +401,7 @@ class Core:
         return unknown
 
     def sync(self, unknown: List[WireEvent],
-             unlocked=None) -> Dict[str, int]:
+             unlocked=None, wrap_fresh_only: bool = False) -> Dict[str, int]:
         """Insert synced events, then wrap the tx pool and the other
         party's head in a new self-event — reference node/core.go:190-230.
 
@@ -408,12 +444,13 @@ class Core:
         t_sync = time.perf_counter_ns()
 
         with self.trace.span("sync", cat="sync", batch=len(unknown)):
-            stats = self._sync_batch(unknown, unlocked)
+            stats = self._sync_batch(unknown, unlocked, wrap_fresh_only)
         self._merge_store_phases()
         self._timed("sync", t_sync)
         return stats
 
-    def _sync_batch(self, unknown, unlocked=None) -> Dict[str, int]:
+    def _sync_batch(self, unknown, unlocked=None,
+                    wrap_fresh_only: bool = False) -> Dict[str, int]:
         # Columnar batches get a wire_unpack stamp (the column ->
         # Event materialization is the unpack; the legacy path's JSON
         # decode happened in the transport) so /debug/phases splits the
@@ -425,16 +462,36 @@ class Core:
             self._timed("wire_unpack", t0)
         self._timed("from_wire", t0)
 
+        # Dedup-before-verify (ROADMAP crypto-plane lever 1): an event
+        # already in the store OR currently in another batch's unlocked
+        # verify window costs a hash lookup here instead of ~98 us of
+        # ECDSA. The rare loser of the in-flight race inserts with a
+        # cold memo and verifies inline at insert — correctness is the
+        # insert loop's re-check either way.
         t0 = time.perf_counter_ns()
         has_event = self.hg.store.has_event
-        to_verify = [ev for ev in events if not has_event(ev.hex())]
-        if to_verify:
-            if unlocked is not None:
-                with unlocked():
+        inflight = self._verify_inflight
+        to_verify = [ev for ev in events
+                     if not has_event(ev.hex())
+                     and ev.hex() not in inflight]
+        verifying = {ev.hex() for ev in to_verify}
+        inflight.update(verifying)
+        try:
+            if to_verify:
+                self._m_verified.inc(len(to_verify))
+                if unlocked is not None:
+                    with unlocked():
+                        verify_events(to_verify, self.verify_workers)
+                else:
                     verify_events(to_verify, self.verify_workers)
-            else:
-                verify_events(to_verify, self.verify_workers)
-        self._timed("verify", t0)
+            self._timed("verify", t0)
+            return self._insert_batch(unknown, events, has_event,
+                                      wrap_fresh_only)
+        finally:
+            inflight.difference_update(verifying)
+
+    def _insert_batch(self, unknown, events, has_event,
+                      wrap_fresh_only: bool = False) -> Dict[str, int]:
 
         # One sync batch = one durable transaction (store.py atomicity
         # seam): the inserted events AND the self-event wrapping them
@@ -449,10 +506,12 @@ class Core:
         # absent) apart from a genuinely new event. The snapshot is an
         # O(n) walk — deliberately charged to the same `known` phase
         # the accounting exists to measure.
+        columnar = not isinstance(unknown, list)
         tips = (self.known()
                 if (self._observatory and events) else None)
         n_new = n_stale = 0
         prop: List[Event] = []  # fresh remote events carrying a stamp
+        fresh_events: List[Event] = []  # first-seen inserts, topo order
         my_hex = self.hex_id()
 
         t0 = time.perf_counter_ns()
@@ -470,6 +529,7 @@ class Core:
                 # event was skipped as a duplicate.
                 fresh = [ev for ev in events if not has_event(ev.hex())]
                 batch_insert(fresh)
+                fresh_events.extend(fresh)
                 for ev in fresh:
                     if (tips is not None and ev.index()
                             <= tips.get(ev.body.creator_id, -1)):
@@ -494,6 +554,7 @@ class Core:
                         else:
                             n_new += 1
                         self.insert_event(ev, False)
+                        fresh_events.append(ev)
                         if ev.trace_id:
                             traced.append(ev.trace_id)
                         if ev.create_ns and ev.creator() != my_hex:
@@ -508,7 +569,41 @@ class Core:
                         other_head = ev.hex()
             self._timed("insert", t0)
 
-            if len(unknown) > 0 or len(self.transaction_pool) > 0:
+            # Epidemic broadcast (docs/gossip.md): hand the fresh
+            # inserts to the owner BEFORE wrapping them, so the relay
+            # buffers stay in topological order (the wrap self-event —
+            # whose other-parent is in this batch — notifies from
+            # sign_and_insert_self_event right after).
+            if self.fresh_observer is not None and fresh_events:
+                self.fresh_observer(fresh_events)
+
+            # wrap_fresh_only (plumtree ingest legs, docs/gossip.md):
+            # wrap only when the batch delivered something fresh AND
+            # consensus still has undecided payload (pending_loaded) to
+            # make progress on. A fully-duplicate push must not spawn a
+            # wrap self-event — the wrap would itself be relayed,
+            # amplifying exactly the redundancy PRUNE is busy
+            # converging away — and once every payload event is
+            # ordered, the tree quiesces like the reference's
+            # need_gossip-gated loop instead of relaying empty wraps
+            # forever.
+            if wrap_fresh_only:
+                wrap = bool(fresh_events) and \
+                    self.hg.pending_loaded_events > 0
+                # Wrap pacing: under congestion, batch several syncs
+                # (and their pooled txs) into one wrap event.
+                if (wrap or self.transaction_pool) \
+                        and self.wrap_min_interval > 0.0 \
+                        and (time.monotonic() - self._last_wrap_ts
+                             < self.wrap_min_interval):
+                    wrap = False
+                    pool_gate = False
+                else:
+                    pool_gate = True
+            else:
+                wrap = len(unknown) > 0
+                pool_gate = True
+            if wrap or (pool_gate and len(self.transaction_pool) > 0):
                 new_head = Event.new(
                     list(self.transaction_pool),
                     [self.head, other_head],

@@ -41,6 +41,12 @@ from ..net.transport import (
     EagerSyncResponse,
     FastForwardRequest,
     FastForwardResponse,
+    GraftRequest,
+    GraftResponse,
+    IHaveRequest,
+    IHaveResponse,
+    PruneRequest,
+    PruneResponse,
     RPC,
     SyncRequest,
     SyncResponse,
@@ -54,6 +60,7 @@ from .control_timer import ControlTimer
 from .core import Core
 from .health import DivergenceSentinel, StallWatchdog
 from .peer_selector import HealthTrackingPeerSelector, RandomPeerSelector
+from .plumtree import Plumtree
 from .state import NodeState, StateMachine
 
 
@@ -218,6 +225,48 @@ class Node:
         self.core_lock = threading.Lock()
         # At most two gossip rounds in flight (see _babble).
         self._gossip_slots = threading.Semaphore(2)
+        # Anti-entropy rounds under plumtree run ONE at a time: two
+        # concurrent pulls answer with overlapping diffs computed
+        # against known maps that do not see each other's inserts —
+        # exactly the stale-known-map duplicate mechanism the tree
+        # exists to remove (serial pulls compute each diff after the
+        # previous round's inserts landed).
+        self._ae_slots = threading.Semaphore(1)
+        # Plumtree eager-ins get their own bounded handler slots: the
+        # single background worker serializes every inbound RPC, and a
+        # tree hop stuck behind a queue of syncs turns ms-latency eager
+        # delivery into worker-queue latency (the unlocked verify seam
+        # also only parallelizes when two batches are in flight).
+        # Per-edge ordering is preserved — each pusher keeps at most
+        # one push outstanding per edge.
+        self._push_slots = threading.Semaphore(2)
+
+        # Epidemic broadcast tree (node/plumtree.py, docs/gossip.md):
+        # fresh events eager-push along a lazily-repaired spanning
+        # tree; lazy peers get IHAVE digests and GRAFT gaps back; the
+        # pull loop below degrades to a low-frequency anti-entropy
+        # backstop. conf.plumtree=False (--no_plumtree) restores the
+        # reference's pull-only gossip byte-for-byte.
+        peer_addrs = [p.net_addr for p in participants
+                      if p.net_addr != self.local_addr]
+        self.plumtree: Optional[Plumtree] = (
+            Plumtree(self, peer_addrs)
+            if getattr(conf, "plumtree", True) and peer_addrs else None)
+        # Which peer delivered the batch currently inside Core.sync —
+        # read by the fresh-event observer so relays never push an
+        # event back up the edge it arrived on. Guarded by core_lock
+        # (every Core.sync call site holds it).
+        self._sync_exclude = ""
+        if self.plumtree is not None:
+            self.core.fresh_observer = self._on_fresh_events
+        self._next_anti_entropy = 0.0
+        # Saturation signal for the opportunistic anti-entropy burst:
+        # the last pull's round trip. Fast RTTs mean the cluster has
+        # spare cycles and heartbeat-paced pulls buy millisecond
+        # delivery; slow RTTs mean every diff is computed against a
+        # known map that aged in a server queue — more pulls then only
+        # add duplicates.
+        self._last_pull_rtt = 0.0
 
         if getattr(conf, "breaker_threshold", 0) > 0:
             self.peer_selector = HealthTrackingPeerSelector(
@@ -322,6 +371,10 @@ class Node:
     def run(self, gossip: bool = True) -> None:
         self.start_time = time.monotonic()
         self.control_timer.run()
+        if gossip and self.plumtree is not None:
+            # Sender/timer threads only exist on a gossiping node — a
+            # serve-only node (tests drive it manually) must not push.
+            self.plumtree.start()
         self._start_forwarders()
         self.state.go_func(self._do_background_work)
         if self.conf.consensus_interval > 0:
@@ -350,6 +403,8 @@ class Node:
         self.state.set_state(NodeState.SHUTDOWN)
         self._shutdown.set()
         self._work.put(("shutdown", None))
+        if self.plumtree is not None:
+            self.plumtree.shutdown()
         self.control_timer.shutdown()
         self.state.wait_routines(timeout=2.0)
         self.trans.close()
@@ -428,7 +483,35 @@ class Node:
                 ticked = False
 
             if ticked:
+                plum = self.plumtree is not None
                 if gossip:
+                    pull_due = True
+                    if plum:
+                        # Plumtree mode (docs/gossip.md): the tick
+                        # wraps pending txs (eager push relays the
+                        # wrap); the pull loop runs as the anti-entropy
+                        # backstop — on its capped cadence under load,
+                        # but OPPORTUNISTICALLY at heartbeat pace while
+                        # the node is idle with undecided payload
+                        # pending (an idle worker queue means a pull
+                        # costs spare cycles and buys the legacy loop's
+                        # millisecond delivery latency; a backed-up
+                        # queue means the cluster is saturated and
+                        # extra pulls would only thrash it).
+                        self._plumtree_tick()
+                        # Self-clocked: rounds are serialized (one AE
+                        # slot), so the burst re-pulls as soon as the
+                        # previous round finished — and a blocked
+                        # puller ingests nothing meanwhile, so each
+                        # diff is computed against an accurate known
+                        # map (near-zero duplicate cost even under
+                        # saturation, unlike the legacy 2-slot loop).
+                        burst = (self._work.qsize() <= 2
+                                 and self.core.need_gossip())
+                        pull_due = (time.monotonic()
+                                    >= self._next_anti_entropy
+                                    or self.state.is_starting()
+                                    or burst)
                     # Bounded concurrency: without the semaphore every
                     # heartbeat tick spawns a gossip round, and once
                     # syncs slow down (peer busy, device wait) rounds
@@ -436,10 +519,12 @@ class Node:
                     # whole process. Two in flight keeps pull/push
                     # overlap without the pile-up (the reference's
                     # gossip rounds are effectively sequential).
-                    if self._gossip_slots.acquire(blocking=False):
+                    slots = self._ae_slots if plum \
+                        else self._gossip_slots
+                    if pull_due and slots.acquire(blocking=False):
                         spawned = False
                         try:
-                            proceed = self._pre_gossip()
+                            proceed = self._pre_gossip(force=plum)
                             if proceed:
                                 # Under the selector lock: next() can
                                 # mutate breaker state (half-open probe
@@ -452,15 +537,30 @@ class Node:
                             if peer is not None:
                                 addr = peer.net_addr
                                 self.state.go_func(
-                                    lambda: self._gossip_bounded(addr))
+                                    lambda: self._gossip_bounded(
+                                        addr, slots))
                                 spawned = True
+                                if plum:
+                                    iv = getattr(
+                                        self.conf,
+                                        "anti_entropy_interval", 1.0)
+                                    self._next_anti_entropy = (
+                                        time.monotonic()
+                                        + iv * (0.75
+                                                + 0.5 * random.random()))
                         finally:
                             # A slot leaked here (selector or thread
                             # spawn raising) would permanently shrink
-                            # the 2-slot gossip budget.
+                            # the gossip-round budget.
                             if not spawned:
-                                self._gossip_slots.release()
-                if not self.core.need_gossip():
+                                slots.release()
+                if plum:
+                    # The tree needs the heartbeat alive for tx wraps
+                    # and the anti-entropy cadence; idle ticks are a
+                    # timer reset and two cheap checks.
+                    if not self.control_timer.set:
+                        self.control_timer.reset()
+                elif not self.core.need_gossip():
                     self.control_timer.stop()
                 elif not self.control_timer.set:
                     self.control_timer.reset()
@@ -470,11 +570,12 @@ class Node:
             if self.state.get_state() != old_state:
                 return
 
-    def _gossip_bounded(self, addr: str) -> None:
+    def _gossip_bounded(self, addr: str, slots=None) -> None:
         try:
             self._gossip(addr)
         finally:
-            self._gossip_slots.release()
+            (slots if slots is not None
+             else self._gossip_slots).release()
 
     @contextlib.contextmanager
     def _core_unlocked(self):
@@ -619,10 +720,14 @@ class Node:
                and not self._shutdown.is_set()):
             time.sleep(0.005)
 
-    def _pre_gossip(self) -> bool:
+    def _pre_gossip(self, force: bool = False) -> bool:
+        """`force` (plumtree anti-entropy): the backstop pull runs on
+        its cadence regardless of need_gossip — the whole point is to
+        find events we do not know we are missing."""
         self._throttle_ingest()
         with self.core_lock:
-            need = self.core.need_gossip() or self.state.is_starting()
+            need = (force or self.core.need_gossip()
+                    or self.state.is_starting())
             if not need:
                 return False
             try:
@@ -631,6 +736,34 @@ class Node:
                 self.logger.error("adding self event: %s", exc)
                 return False
             return True
+
+    def _plumtree_tick(self) -> None:
+        """Heartbeat work in plumtree mode: wrap pending transactions
+        into a self-event (the fresh-event observer relays it down the
+        tree). In an active net the pool usually drains through sync
+        wrap events first, so this fires mainly on quiet nodes. The
+        adaptive wrap pacing applies here too — under congestion the
+        pool accumulates into one larger wrap."""
+        core = self.core
+        if not core.transaction_pool:
+            return
+        if core.wrap_min_interval > 0.0 and \
+                time.monotonic() - core._last_wrap_ts \
+                < core.wrap_min_interval:
+            return
+        self._throttle_ingest()
+        with self.core_lock:
+            try:
+                core.add_self_event()
+            except Exception as exc:  # noqa: BLE001
+                self.logger.error("adding self event: %s", exc)
+
+    def _on_fresh_events(self, events) -> None:
+        """Core's fresh-event hook (called under the core lock): relay
+        first-seen inserts and own self-events down the tree, excluding
+        the edge they arrived on."""
+        if self.plumtree is not None:
+            self.plumtree.enqueue_fresh(events, self._sync_exclude)
 
     # -- peer health feedback (circuit breaker) ---------------------------
 
@@ -649,6 +782,11 @@ class Node:
         if tripped:
             self.logger.warning(
                 "peer %s suspended (circuit breaker tripped)", peer_addr)
+            if self.plumtree is not None:
+                # Tree self-healing (docs/gossip.md): a suspended peer
+                # leaves the eager set at once and the best-scoring
+                # healthy lazy peer takes the vacant edge.
+                self.plumtree.on_peer_suspended(peer_addr)
 
     def _gossip(self, peer_addr: str) -> None:
         if self._shutdown.is_set():
@@ -682,13 +820,19 @@ class Node:
                 self.state.set_state(NodeState.CATCHING_UP)
                 return
 
-            try:
-                self._push(peer_addr, other_known)
-            except Exception as exc:  # noqa: BLE001
-                self.logger.debug("push to %s failed: %s", peer_addr, exc)
-                rec["outcome"] = "push_failed"
-                self._peer_failed(peer_addr)
-                return
+            if self.plumtree is None:
+                # Legacy round-trailing push. Plumtree mode skips it:
+                # the eager tree already delivered our fresh events,
+                # and a known-map push here would re-offer exactly the
+                # duplicates the tree converged away.
+                try:
+                    self._push(peer_addr, other_known)
+                except Exception as exc:  # noqa: BLE001
+                    self.logger.debug(
+                        "push to %s failed: %s", peer_addr, exc)
+                    rec["outcome"] = "push_failed"
+                    self._peer_failed(peer_addr)
+                    return
             rec["outcome"] = "ok"
 
         self._peer_ok(peer_addr)
@@ -801,7 +945,20 @@ class Node:
         t3 = self.clock.epoch_ns()
         # Per-peer pull RTT: only SUCCESSFUL round trips (a timeout's
         # wall measures the timeout knob, not the network).
-        self._rtt_hist(peer_addr, "pull").observe(time.monotonic() - t0)
+        rtt = time.monotonic() - t0
+        self._last_pull_rtt = rtt
+        if self.plumtree is not None:
+            # Adaptive wrap pacing (docs/gossip.md): the pull round
+            # trip is the live congestion estimate — a saturated
+            # cluster batches many syncs into one wrap self-event
+            # (fewer events for every node to ECDSA and order), an
+            # idle one wraps at heartbeat pace like the reference.
+            # Capped at 1 s: round cadence cannot outrun wrap cadence
+            # (witnesses per round come from wraps), so starving wraps
+            # further would slow decisions more than it saves ECDSA.
+            self.core.wrap_min_interval = min(
+                max(self.conf.heartbeat_timeout, rtt / 2.0), 1.0)
+        self._rtt_hist(peer_addr, "pull").observe(rtt)
         if resp.t_recv and resp.t_origin == req.t_send:
             self.clock.observe(
                 peer_addr, req.t_send, resp.t_recv, resp.t_reply, t3)
@@ -812,10 +969,18 @@ class Node:
             return True, None
 
         self._throttle_ingest()
+        # Leg attribution (docs/gossip.md): under plumtree the pull
+        # loop is the anti-entropy backstop, and its redundancy is
+        # accounted separately from the tree's eager plane.
+        plum = self.plumtree is not None
+        leg = "lazy_pull" if plum else "pull"
         with self.core_lock:
             if self._shutdown.is_set():
                 raise TransportError("node is shutting down")
-            self._sync(resp.events, peer_addr, "pull")
+            # wrap_fresh_only under plumtree: an anti-entropy pull that
+            # found nothing new (the common case) must not spawn a wrap
+            # event, or the idle tree would trickle forever.
+            self._sync(resp.events, peer_addr, leg, wrap_fresh_only=plum)
         return False, resp.known
 
     def _push(self, peer_addr: str, known: Dict[int, int]) -> None:
@@ -835,7 +1000,8 @@ class Node:
         self._rtt_hist(peer_addr, "push").observe(time.monotonic() - t0)
         self._flow_gossip_hop(wire_events, "push", peer_addr)
 
-    def _sync(self, events, peer_addr: str = "", leg: str = "") -> None:
+    def _sync(self, events, peer_addr: str = "", leg: str = "",
+              wrap_fresh_only: bool = False):
         """Insert synced events + run consensus (caller holds core_lock)
         — reference node/node.go:467-487. With consensus_interval > 0
         the pass moves to the dedicated consensus worker: syncs are
@@ -845,8 +1011,15 @@ class Node:
         this node keeps answering pulls and accepting pushes while the
         verify pool grinds the batch. `peer_addr`/`leg` attribute the
         batch's redundancy classification to whoever delivered it
-        (docs/observability.md "Gossip efficiency")."""
-        stats = self.core.sync(events, unlocked=self._core_unlocked)
+        (docs/observability.md "Gossip efficiency"); the fresh-event
+        observer relays first-seen inserts down the tree, excluding the
+        delivering edge. Returns the classification stats."""
+        self._sync_exclude = peer_addr
+        try:
+            stats = self.core.sync(events, unlocked=self._core_unlocked,
+                                   wrap_fresh_only=wrap_fresh_only)
+        finally:
+            self._sync_exclude = ""
         if peer_addr and self._observatory:
             self._record_gossip(peer_addr, leg, stats, events)
         self._syncs_applied += 1
@@ -859,6 +1032,7 @@ class Node:
             os.kill(os.getpid(), signal.SIGKILL)
         if self.conf.consensus_interval <= 0:
             self.core.run_consensus()
+        return stats
 
     def _fast_forward(self) -> None:
         """CatchingUp: pull a Frame from a peer and reset+replay
@@ -916,6 +1090,8 @@ class Node:
             # Answer with the response type matching the request — an
             # EagerSync/FastForward caller fed a SyncResponse would die
             # on the response-type check instead of the real error.
+            # The plumtree RPC kinds follow the same rule (PR 2's
+            # not-ready contract covers every request type).
             cmd = rpc.command
             if isinstance(cmd, EagerSyncRequest):
                 resp = EagerSyncResponse(self.id, False)
@@ -923,6 +1099,12 @@ class Node:
                 resp = FastForwardResponse(self.id)
             elif isinstance(cmd, SyncRequest):
                 resp = SyncResponse(self.id)
+            elif isinstance(cmd, IHaveRequest):
+                resp = IHaveResponse(self.id, False)
+            elif isinstance(cmd, GraftRequest):
+                resp = GraftResponse(self.id)
+            elif isinstance(cmd, PruneRequest):
+                resp = PruneResponse(self.id, False)
             else:
                 resp = None
             rpc.respond(resp, TransportError(f"not ready: {state}"))
@@ -931,7 +1113,25 @@ class Node:
         if isinstance(cmd, SyncRequest):
             self._process_sync_request(rpc, cmd)
         elif isinstance(cmd, EagerSyncRequest):
-            self._process_eager_sync_request(rpc, cmd)
+            # Plumtree tree hops ride a bounded side lane when one is
+            # free; the worker handles them inline otherwise (and
+            # always under --no_plumtree).
+            if getattr(cmd, "plum", False) \
+                    and self._push_slots.acquire(blocking=False):
+                def handle(rpc=rpc, cmd=cmd):
+                    try:
+                        self._process_eager_sync_request(rpc, cmd)
+                    finally:
+                        self._push_slots.release()
+                self.state.go_func(handle)
+            else:
+                self._process_eager_sync_request(rpc, cmd)
+        elif isinstance(cmd, IHaveRequest):
+            self._process_ihave_request(rpc, cmd)
+        elif isinstance(cmd, GraftRequest):
+            self._process_graft_request(rpc, cmd)
+        elif isinstance(cmd, PruneRequest):
+            self._process_prune_request(rpc, cmd)
         elif isinstance(cmd, FastForwardRequest):
             self._process_fast_forward_request(rpc, cmd)
         else:
@@ -1015,13 +1215,97 @@ class Node:
                         TransportError("engine backlog over limit"))
             return
         addr = self._addr_by_id.get(cmd.from_id, f"id{cmd.from_id}")
+        # Plumtree eager legs (docs/gossip.md) are accounted separately
+        # from the reference's round-trailing push, never wrap a fully-
+        # duplicate batch, and feed the tree's optimization signals.
+        plum = bool(getattr(cmd, "plum", False))
+        leg = "eager" if plum else "push_in"
+        stats = None
         with self.core_lock:
             try:
-                self._sync(cmd.events, addr, "push_in")
+                stats = self._sync(cmd.events, addr, leg,
+                                   wrap_fresh_only=plum)
             except Exception as exc:  # noqa: BLE001
                 success = False
                 err = exc
+        if plum and self.plumtree is not None:
+            if err is not None:
+                # A parent gap, not a transport fault: answer
+                # success=False WITHOUT an error (the pusher must not
+                # trip its breaker over tree churn) and repair by
+                # pulling the exact difference from the sender.
+                self.logger.debug(
+                    "eager push from %s gapped: %s — grafting", addr, err)
+                self.plumtree.schedule_repair(addr)
+                err = None
+            elif stats and stats["offered"] > 0:
+                # The Plumtree optimization rule, batched: feed the
+                # edge's duplicate window — an edge delivering mostly
+                # duplicates gets PRUNEd down to lazy.
+                self.plumtree.note_push_stats(
+                    addr, stats["new"] + stats["stale"],
+                    stats["duplicate"])
         rpc.respond(EagerSyncResponse(self.id, success), err)
+
+    def _process_ihave_request(self, rpc: RPC, cmd: IHaveRequest) -> None:
+        """Lazy-plane digest announcement: remember what we are missing
+        and who has it; the graft timer fires only if the eager plane
+        never delivers. A plumtree-off node acks benignly — digests
+        carry no obligations, and its own pulls fetch everything."""
+        addr = self._addr_by_id.get(cmd.from_id, f"id{cmd.from_id}")
+        digests = cmd.digests
+        if not isinstance(digests, list):
+            digests = digests.to_list()
+        if self.plumtree is not None:
+            self.plumtree.on_ihave(addr, digests)
+        rpc.respond(IHaveResponse(self.id, True), None)
+
+    def _process_graft_request(self, rpc: RPC, cmd: GraftRequest) -> None:
+        """GRAFT = known-map pull + eager promotion of the requester.
+        Serving is independent of our own plumtree flag (it is just a
+        pull); the promotion half only applies when the tree is on.
+        The response payload respects max_msg_bytes: an over-size diff
+        is cut to the largest topological prefix that fits (the
+        requester's next graft or anti-entropy round picks up the
+        rest), and a requester beyond sync_limit is pointed at
+        fast-sync instead."""
+        from ..net.columnar import wire_payload_nbytes
+
+        addr = self._addr_by_id.get(cmd.from_id, f"id{cmd.from_id}")
+        if self.plumtree is not None:
+            self.plumtree.on_graft(addr)
+        resp = GraftResponse(self.id)
+        resp_err: Optional[Exception] = None
+        with self.core_lock:
+            over_limit = self.core.over_sync_limit(
+                cmd.known, self.conf.sync_limit)
+        if over_limit:
+            resp.sync_limit = True
+        else:
+            try:
+                with self.core_lock:
+                    diff = self.core.diff(cmd.known)
+                fmt = ("columnar" if rpc.wire.startswith("columnar")
+                       else self._wire_format)
+                payload = self.core.to_wire_batch(diff, fmt)
+                cap = getattr(self.conf, "max_msg_bytes", 32 << 20)
+                while diff and wire_payload_nbytes(payload) > cap:
+                    diff = diff[:max(1, len(diff) // 2)] \
+                        if len(diff) > 1 else []
+                    payload = self.core.to_wire_batch(diff, fmt)
+                if not diff and not isinstance(payload, list):
+                    payload = []
+                resp.events = payload
+                self._flow_gossip_hop(resp.events, "serve", cmd.from_id)
+            except Exception as exc:  # noqa: BLE001
+                resp_err = exc
+        rpc.respond(resp, resp_err)
+
+    def _process_prune_request(self, rpc: RPC, cmd: PruneRequest) -> None:
+        addr = self._addr_by_id.get(cmd.from_id, f"id{cmd.from_id}")
+        if self.plumtree is not None:
+            self.plumtree.on_prune(addr)
+        rpc.respond(PruneResponse(self.id, True), None)
 
     def _process_fast_forward_request(
             self, rpc: RPC, cmd: FastForwardRequest) -> None:
@@ -1236,6 +1520,15 @@ class Node:
             g("babble_clock_adjust_ns",
               "This node's adjustment onto the cluster epoch (ns)"
               ).set(self.clock.cluster_adjust_ns())
+        # Epidemic broadcast tree shape (docs/gossip.md): eager/lazy
+        # set sizes chart tree churn next to the graft/prune counters.
+        if self.plumtree is not None:
+            g("babble_plumtree_eager_peers",
+              "Peers on this node's eager push set (tree edges)").set(
+                len(self.plumtree.eager_peers()))
+            g("babble_plumtree_lazy_peers",
+              "Peers on the lazy IHAVE plane").set(
+                len(self.plumtree.lazy_peers()))
         # Per-peer circuit-breaker view (empty snapshot when health
         # tracking is disabled — the gauges then simply never appear).
         state_code = {"closed": 0, "half_open": 1, "open": 2}
@@ -1352,6 +1645,48 @@ class Node:
             snapshot = getattr(self.peer_selector, "snapshot", None)
             return snapshot() if snapshot else {}
 
+    # -- peer scoring (docs/gossip.md) -------------------------------------
+
+    def peer_healthy(self, addr: str) -> bool:
+        """Breaker view for tree decisions: closed = healthy. True when
+        health tracking is disabled."""
+        with self.selector_lock:
+            snapshot = getattr(self.peer_selector, "snapshot", None)
+            if snapshot is None:
+                return True
+            h = snapshot().get(addr)
+        return h is None or h["state"] == "closed"
+
+    def peer_score(self, addr: str) -> float:
+        """Eager-peer desirability in [0, 1]: the fraction of this
+        peer's deliveries that were NEW (PR 10 redundancy accounting),
+        damped by delivery RTT (PR 5 histograms) — the tree prefers
+        edges whose pushes are mostly new and fast. Peers without
+        history get a middling prior so fresh edges still get tried."""
+        new = dup = 0.0
+        for (peer, _leg), ch in list(self._gossip_children.items()):
+            if peer == addr:
+                new += ch["new"].value
+                dup += ch["duplicate"].value
+        fresh = (new / (new + dup)) if (new + dup) > 0 else 0.75
+        rtt_ms = None
+        for leg in ("eager", "pull", "graft", "push"):
+            h = self._rtt_hists.get((addr, leg))
+            if h is not None and h.count:
+                rtt_ms = h.snapshot().quantile(0.5) * 1e3
+                break
+        return fresh / (1.0 + (rtt_ms if rtt_ms is not None else 20.0)
+                        / 50.0)
+
+    def plumtree_peer_roles(self) -> Dict[str, str]:
+        """addr -> "eager" | "lazy" for /debug/peers; empty when the
+        tree is off."""
+        if self.plumtree is None:
+            return {}
+        roles = {a: "eager" for a in self.plumtree.eager_peers()}
+        roles.update({a: "lazy" for a in self.plumtree.lazy_peers()})
+        return roles
+
     # -- consensus health views (docs/observability.md) --------------------
 
     def round_lag(self) -> int:
@@ -1450,6 +1785,13 @@ class Node:
             "totals": self._gossip_row(totals),
             "peers": peers,
         }
+        # Epidemic broadcast tree view (docs/gossip.md): the eager/lazy
+        # split, graft/prune churn, shed counts, and per-peer push
+        # backlog — read next to the per-leg redundancy rows above
+        # (legs: eager, ihave, graft, lazy_pull vs legacy pull/push_in).
+        out["plumtree"] = (self.plumtree.snapshot()
+                           if self.plumtree is not None
+                           else {"enabled": False})
         prop = getattr(self.core, "_m_propagation", None)
         if prop is not None and prop.count:
             snap = prop.snapshot()

@@ -188,6 +188,13 @@ class Service:
                     for addr, eff in node.gossip_peer_efficiency() \
                             .items():
                         peers.setdefault(addr, {}).update(eff)
+                    # Epidemic broadcast tree membership
+                    # (docs/gossip.md): is this peer an eager tree
+                    # edge or on the lazy IHAVE plane?
+                    for addr, role in node.plumtree_peer_roles() \
+                            .items():
+                        peers.setdefault(addr, {})["plumtree_edge"] = \
+                            role
                     lcr = core.get_last_consensus_round_index()
                     self._json(200, {
                         "engine_state": core.engine_state,

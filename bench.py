@@ -378,7 +378,7 @@ def build_host_testnet(n_nodes, engine="host", interval=0.0,
                        heartbeat=0.0015, store="inmem",
                        store_sync="batch", trace_sample=0.0,
                        wire_format="columnar", transport="inmem",
-                       health=True, observatory=True):
+                       health=True, observatory=True, plumtree=True):
     """Construct (but do not start) a localhost testnet of N real
     nodes: signed keys, fully-meshed transports, per-node stores and
     app proxies — the shared builder behind the throughput smoke, the
@@ -445,6 +445,10 @@ def build_host_testnet(n_nodes, engine="host", interval=0.0,
         # + propagation histogram; observatory=False is the baseline
         # leg of the --gossip-overhead A/B.
         conf.gossip_observatory = observatory
+        # Epidemic broadcast tree (docs/gossip.md): the product default
+        # since the plumtree PR; plumtree=False is the pull-only
+        # baseline (the committed pre-plumtree SOAK ledger's shape).
+        conf.plumtree = plumtree
         if store == "file":
             # Durable-path A/B (docs/robustness.md "Crash recovery"):
             # same testnet over WAL-backed FileStores, so the
@@ -1094,6 +1098,28 @@ def gossip_soak_leg(n, wall_s, scrape_s, ts_file, probes=5):
     agg_snap = lambda nd: {  # noqa: E731
         k: c.value for k, c in nd._m_gossip_agg.items()}
 
+    def plumtree_snap():
+        out = {"grafts": 0, "prunes": 0, "shed": 0}
+        for nd in nodes:
+            pt = nd.plumtree
+            if pt is None:
+                continue
+            out["grafts"] += int(pt._m_graft["tx"].value)
+            out["prunes"] += int(pt._m_prune["tx"].value)
+            out["shed"] += int(pt._m_shed.value)
+        return out
+
+    def leg_snap():
+        # Cluster totals per ingest leg (eager / lazy_pull / graft /
+        # pull / push_in): the acceptance split for the tree rewrite.
+        out: dict = {}
+        for nd in nodes:
+            for (_p, leg), ch in list(nd._gossip_children.items()):
+                row = out.setdefault(leg, {"new": 0, "duplicate": 0})
+                row["new"] += int(ch["new"].value)
+                row["duplicate"] += int(ch["duplicate"].value)
+        return out
+
     import sys as _sys
     old_switch = _sys.getswitchinterval()
     _sys.setswitchinterval(0.1)
@@ -1103,8 +1129,12 @@ def gossip_soak_leg(n, wall_s, scrape_s, ts_file, probes=5):
             nd.run_async(gossip=True)
         threading.Thread(target=bombard, daemon=True).start()
         # Warmup: first commits prove the net is live before the
-        # measurement window opens.
-        deadline = time.monotonic() + max(6.0, wall_s / 3.0)
+        # measurement window opens. The cap scales with n — at n=32
+        # the first rounds take ~60 s to decide (round cadence is the
+        # cluster's end-to-end propagation time, not CPU), and opening
+        # the window during that ramp measures the ramp, not the
+        # steady state.
+        deadline = time.monotonic() + max(6.0, wall_s / 3.0, 3.0 * n)
         while time.monotonic() < deadline and committed() < 100:
             time.sleep(0.25)
         threading.Thread(target=probe_loop, daemon=True).start()
@@ -1112,6 +1142,8 @@ def gossip_soak_leg(n, wall_s, scrape_s, ts_file, probes=5):
         c0, t0 = committed(), time.monotonic()
         g0 = [agg_snap(nd) for nd in nodes]
         p0 = [nd.core._m_propagation.snapshot() for nd in nodes]
+        pt0 = plumtree_snap()
+        legs0 = leg_snap()
         phase0: dict = {}
         for nd in nodes:
             for ph, ent in list(nd.core.phase_ns.items()):
@@ -1147,6 +1179,8 @@ def gossip_soak_leg(n, wall_s, scrape_s, ts_file, probes=5):
         wall = time.monotonic() - t0
         c1 = committed()
         g1 = [agg_snap(nd) for nd in nodes]
+        pt1 = plumtree_snap()
+        legs1 = leg_snap()
         prop = None
         for nd, before in zip(nodes, p0):
             delta = nd.core._m_propagation.snapshot() - before
@@ -1164,6 +1198,19 @@ def gossip_soak_leg(n, wall_s, scrape_s, ts_file, probes=5):
 
     tot = {k: sum(b[k] - a[k] for a, b in zip(g0, g1))
            for k in g0[0]} if g0 else {}
+    plumtree_counters = ({k: pt1[k] - pt0[k] for k in pt1}
+                         if any(nd.plumtree is not None for nd in nodes)
+                         else {})
+    leg_totals = {}
+    for lg, row1 in legs1.items():
+        row0 = legs0.get(lg, {"new": 0, "duplicate": 0})
+        lnew = row1["new"] - row0["new"]
+        ldup = row1["duplicate"] - row0["duplicate"]
+        if lnew or ldup:
+            leg_totals[lg] = {
+                "new": lnew, "duplicate": ldup,
+                "redundancy_ratio": (round(ldup / lnew, 3)
+                                     if lnew else None)}
     offered = tot.get("offered", 0)
     new = tot.get("new", 0)
     dup = tot.get("duplicate", 0)
@@ -1201,6 +1248,21 @@ def gossip_soak_leg(n, wall_s, scrape_s, ts_file, probes=5):
         leg["propagation_p50_ms"] = round(prop.quantile(0.5) * 1e3, 2)
         leg["propagation_p99_ms"] = round(prop.quantile(0.99) * 1e3, 2)
         leg["propagation_samples"] = prop.count
+    # Epidemic broadcast tree churn (docs/gossip.md): graft/prune
+    # totals over the window — a settled tree shows ~0 churn per
+    # second, repair storms show up immediately.
+    if plumtree_counters:
+        for k, v in plumtree_counters.items():
+            leg[k] = v
+        leg["grafts_per_s"] = round(
+            plumtree_counters.get("grafts", 0) / wall, 2)
+        leg["prunes_per_s"] = round(
+            plumtree_counters.get("prunes", 0) / wall, 2)
+    # Per-leg redundancy split (eager plane vs anti-entropy backstop):
+    # the acceptance view — eager should carry nearly all new events
+    # at low duplicate cost once the tree settles.
+    if leg_totals:
+        leg["legs"] = leg_totals
     if top_sum:
         leg["phase_share"] = {ph: round(v / top_sum, 3)
                               for ph, v in sorted(top.items())}
@@ -1267,9 +1329,15 @@ def gossip_soak():
         for k in ("redundancy_ratio", "duplicate_share",
                   "bytes_per_new_event", "propagation_p50_ms",
                   "propagation_p99_ms", "coverage_ms",
-                  "bookkeeping_share"):
+                  "bookkeeping_share", "grafts_per_s", "prunes_per_s"):
             if leg.get(k) is not None:
                 payload[f"soak{n}_{k}"] = leg[k]
+        # Per-leg redundancy (docs/gossip.md): the eager plane is the
+        # headline — a settled tree delivers ~once per event there.
+        eager = (leg.get("legs") or {}).get("eager") or {}
+        if eager.get("redundancy_ratio") is not None:
+            payload[f"soak{n}_eager_redundancy_ratio"] = \
+                eager["redundancy_ratio"]
         log(f"  n={n}: {leg['events_per_s']:,.1f} ev/s, redundancy "
             f"{leg['redundancy_ratio']}, dup share "
             f"{leg['duplicate_share']}, propagation p99 "

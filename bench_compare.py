@@ -89,11 +89,19 @@ for _n in (3, 8, 16, 32, 64):
     HEADLINES[f"soak{_n}_events_per_s"] = "throughput"
     HEADLINES[f"soak{_n}_propagation_p99_ms"] = "latency"
     HEADLINES[f"soak{_n}_redundancy_ratio"] = "ratio"
+    # Per-leg redundancy (docs/gossip.md): the plumtree eager plane's
+    # ratio rides as info — at small n the anti-entropy plane carries
+    # nearly everything, so the eager leg's ratio is computed over too
+    # few events to gate stably; the blended ratio above is the gate.
+    HEADLINES[f"soak{_n}_eager_redundancy_ratio"] = "ratio-info"
     HEADLINES[f"soak{_n}_duplicate_share"] = "ratio-info"
     HEADLINES[f"soak{_n}_bytes_per_new_event"] = "ratio-info"
     HEADLINES[f"soak{_n}_propagation_p50_ms"] = "latency-info"
     HEADLINES[f"soak{_n}_coverage_ms"] = "latency-info"
     HEADLINES[f"soak{_n}_bookkeeping_share"] = "ratio-info"
+    # Tree churn rides as info: repair storms are diagnosis, not SLO.
+    HEADLINES[f"soak{_n}_grafts_per_s"] = "ratio-info"
+    HEADLINES[f"soak{_n}_prunes_per_s"] = "ratio-info"
 
 YARDSTICK = "host_events_per_s"
 
@@ -136,10 +144,15 @@ def compare(fresh: dict, baseline: dict, tolerance: float,
             bad = delta < -tolerance
         elif kind.startswith("ratio"):
             # Protocol-shape metrics: machine speed cancels out of a
-            # ratio, so no yardstick normalization either way.
+            # ratio, so no yardstick normalization either way. Tiny
+            # baselines (a settled tree's redundancy ratio runs
+            # 0.01-0.1 with ±0.05 scheduler noise between runs) get an
+            # absolute 0.1 slack so near-zero ratios cannot fail the
+            # gate on relative noise — a real regression back toward
+            # the pull-only 0.77+ still fails by a wide margin.
             expected = b
             delta = f / expected - 1.0
-            bad = delta > tolerance
+            bad = f > max(b * (1.0 + tolerance), b + 0.1)
         else:
             expected = b / scale if scale else b
             delta = f / expected - 1.0

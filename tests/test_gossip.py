@@ -281,7 +281,9 @@ def test_node_accounting_and_debug_endpoints():
         with urllib.request.urlopen(
                 f"http://{svc.addr}/debug/gossip", timeout=10) as r:
             gdbg = json.loads(r.read())
-        assert gdbg["totals"]["offered"] == int(agg["offered"])
+        # >= not ==: the endpoint reads LIVE counters, and in-flight
+        # relays may land between the snapshot above and this scrape.
+        assert gdbg["totals"]["offered"] >= int(agg["offered"])
         assert gdbg["peers"]
         peer, legs = next(iter(gdbg["peers"].items()))
         assert "totals" in legs
@@ -309,11 +311,14 @@ def test_node_accounting_and_debug_endpoints():
                     "babble_gossip_payload_bytes_total",
                     "babble_propagation_latency_seconds"):
             assert any(fam in s for s in samples), fam
-        # per-peer children carry peer+leg labels
+        # per-peer children carry peer+leg labels (the plumtree legs
+        # since the epidemic-broadcast PR — docs/gossip.md; the legacy
+        # pull/push_in names survive under --no_plumtree)
         labeled = [lb for lb, v in
                    samples["babble_gossip_offered_events_total"]
                    if "peer" in lb]
-        assert any(lb.get("leg") in ("pull", "push_in")
+        assert any(lb.get("leg") in ("eager", "ihave", "graft",
+                                     "lazy_pull", "pull", "push_in")
                    for lb in labeled)
     finally:
         for nd in nodes:
@@ -336,12 +341,13 @@ def test_duplicate_push_injection_feeds_duplicate_counter():
     assert injected > 0
     dup = sum(nd._m_gossip_agg["duplicate"].value for nd in nodes)
     assert dup > 0, "injected duplicate pushes never hit the counter"
-    # and specifically on the push_in leg of some node
+    # and specifically on an inbound-push leg of some node ("eager"
+    # since the epidemic-broadcast PR; "push_in" under --no_plumtree)
     push_dup = sum(
         ch["duplicate"].value
         for nd in nodes
         for (peer, leg), ch in nd._gossip_children.items()
-        if leg == "push_in")
+        if leg in ("eager", "push_in"))
     assert push_dup > 0
 
 

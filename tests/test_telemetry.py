@@ -9,6 +9,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -312,12 +313,25 @@ def test_metrics_and_trace_endpoints():
     service.serve_async()
     try:
         run_gossip(nodes, target_round=3, shutdown=False)
-        with urllib.request.urlopen(
-                f"http://{service.addr}/metrics", timeout=5) as r:
-            assert r.status == 200
-            assert r.headers["Content-Type"].startswith("text/plain")
-            text = r.read().decode()
-        samples, types = promtext.parse(text)  # valid exposition
+
+        # The submit->commit histogram samples only txs THIS node
+        # stamped — at round 3 node 0's own submissions may still be a
+        # round away from delivery, so keep feeding it and re-scrape
+        # until a sample lands (bounded).
+        deadline = time.monotonic() + 30.0
+        while True:
+            with urllib.request.urlopen(
+                    f"http://{service.addr}/metrics", timeout=5) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/plain")
+                text = r.read().decode()
+            samples, types = promtext.parse(text)  # valid exposition
+            lat = promtext.histogram_snapshot(
+                samples, "babble_commit_latency_seconds")
+            if lat.count > 0 or time.monotonic() > deadline:
+                break
+            nodes[0].submit_tx(b"latency probe tx")
+            time.sleep(0.2)
         assert promtext.check_series(samples, REQUIRED_SERIES) == []
         assert types["babble_commit_latency_seconds"] == "histogram"
         assert types["babble_breaker_state"] == "gauge"
@@ -325,15 +339,16 @@ def test_metrics_and_trace_endpoints():
         # The submit->commit histogram actually observed this node's
         # committed transactions, and the scrape-side quantile math
         # reproduces sane values.
-        lat = promtext.histogram_snapshot(
-            samples, "babble_commit_latency_seconds")
         assert lat.count > 0
         assert 0 < lat.quantile(0.5) <= lat.quantile(0.99)
 
         # Per-peer RTT series carry peer + leg labels.
         rtt_labels = [lb for lb, _ in
                       samples["babble_gossip_rtt_seconds_count"]]
-        assert {lb["leg"] for lb in rtt_labels} <= {"pull", "push"}
+        # Outbound legs: pull/push from the reference loop, plus the
+        # plumtree planes (eager pushes + graft pulls, docs/gossip.md).
+        assert {lb["leg"] for lb in rtt_labels} <= {
+            "pull", "push", "eager", "graft", "ihave"}
         assert all(lb["peer"] for lb in rtt_labels)
 
         # /debug/trace: Perfetto-loadable Chrome trace JSON with the
