@@ -118,6 +118,7 @@ def cmd_run(args) -> int:
             0 if args.no_failover else args.engine_failover_threshold),
         trace_ring=args.trace_ring,
         trace_sample=args.trace_sample,
+        profile_hz=args.profile_hz,
         divergence_sentinel=not args.no_sentinel,
         gossip_observatory=not args.no_gossip_observatory,
         stall_timeout=args.stall_timeout / 1000.0,
@@ -250,6 +251,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "babble_tpu.telemetry.tracemerge. 0 disables "
                          "(no per-tx overhead); 0.001 is the "
                          "documented 'on' rate")
+    rn.add_argument("--profile_hz", type=float, default=0.0,
+                    help="in-process sampling profiler rate (Hz) "
+                         "behind GET /debug/flame (folded-stack text "
+                         "for speedscope/flamegraph.pl). 0 disables "
+                         "the sampler entirely (the endpoint then "
+                         "burst-samples on demand); 99 is the "
+                         "documented 'on' rate, measured within the "
+                         "5%% bar (bench.py --profile-overhead)")
     rn.add_argument("--no_sentinel", action="store_true",
                     help="disable the divergence sentinel (the rolling "
                          "committed-block chain hash piggybacked on "

@@ -45,7 +45,7 @@ import struct
 import threading
 from typing import Dict, List, Optional
 
-from ..telemetry import get_registry
+from ..telemetry import InstrumentedQueue, QueueInstrument, get_registry
 from .columnar import ColumnarDigests, ColumnarEvents, WIRE_VERSION
 from .transport import (
     FastForwardRequest,
@@ -288,7 +288,15 @@ class TCPTransport:
         if self._addr.startswith(":"):
             raise TransportError("local bind address is not advertisable")
 
-        self._consumer: "queue.Queue[RPC]" = queue.Queue(max(1, consumer_buffer))
+        # Inbound RPC queue, instrumented (docs/observability.md
+        # "Saturation"): depth/capacity/wait/drops under
+        # babble_queue_*{queue="tcp_consumer"}. Process-global registry
+        # (the transport predates its node), labelled by bind address.
+        self._consumer: "queue.Queue[RPC]" = InstrumentedQueue(
+            max(1, consumer_buffer),
+            QueueInstrument(
+                get_registry(), "tcp_consumer", max(1, consumer_buffer),
+                addr=self._addr))
         self._pool: Dict[str, List[_Conn]] = {}
         self._pool_lock = threading.Lock()
         self._max_pool = max_pool
@@ -583,9 +591,7 @@ class TCPTransport:
                     continue
 
                 rpc = RPC(cmd, wire=wire)
-                try:
-                    self._consumer.put_nowait(rpc)
-                except queue.Full:
+                if not self._consumer.put_drop(rpc):
                     # Overloaded node: fail the RPC immediately instead
                     # of blocking this handler thread (which would also
                     # stall every later RPC on this connection).

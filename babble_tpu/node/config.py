@@ -207,6 +207,24 @@ class Config:
     # it: no counters, no stamps (wire forms byte-identical to the
     # pre-observatory encoding), no propagation histogram samples.
     gossip_observatory: bool = True
+    # -- saturation observatory (docs/observability.md "Saturation") ---
+    # In-process sampling profiler rate (Hz). 0 (default) = fully off:
+    # no sampler thread, no ring, a strict no-op on the hot path.
+    # > 0 starts one process-global stack sampler over
+    # sys._current_frames() whose ring serves GET /debug/flame as
+    # folded-stack text; the documented "on" rate is 99 Hz, measured
+    # within the 5% bar (bench.py --profile-overhead).
+    profile_hz: float = 0.0
+    # Capacity of the commit channel (decided blocks waiting for
+    # CommitBlock; reference node/node.go's commitCh buffer of 400).
+    # Full = the consensus thread blocks, the backpressure that keeps
+    # a slow app proxy from ballooning memory.
+    commit_queue: int = 400
+    # Capacity of the serialized work queue feeding the background
+    # worker (rpc/tx/block forwarding). Full = the forwarders block,
+    # propagating backpressure to the transport consumer queues
+    # instead of growing an unbounded backlog.
+    work_queue: int = 4096
     # Stall watchdog: when payload events are pending but no consensus
     # round has decided for this many seconds, emit a diagnosis (which
     # round is stuck, which witnesses are undecided, which creators

@@ -27,8 +27,12 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
+
+from ..telemetry import get_registry
+from ..telemetry.queues import QueueInstrument
 
 _MAX_WORKERS = 8
 # Below this batch size the pool's submit/wake overhead beats any
@@ -38,6 +42,22 @@ _MIN_POOL_BATCH = 8
 _pool: Optional[ThreadPoolExecutor] = None
 _pool_size = 0
 _pool_lock = threading.Lock()
+# Saturation accounting for the shared pool (docs/observability.md
+# "Saturation"): chunk submissions stamp an enqueue time; the worker
+# observes submit->start wait. The pool is process-global, so the
+# instrument lives in the process-global registry (no node label);
+# depth reads the executor's pending work queue at scrape time.
+_q_inst: Optional[QueueInstrument] = None
+
+
+def _pool_instrument() -> QueueInstrument:
+    global _q_inst
+    if _q_inst is None:
+        _q_inst = QueueInstrument(
+            get_registry(), "verify_pool", 0,
+            depth_fn=lambda: (_pool._work_queue.qsize()
+                              if _pool is not None else 0))
+    return _q_inst
 
 
 def default_verify_workers() -> int:
@@ -67,7 +87,12 @@ def _get_pool(workers: int) -> ThreadPoolExecutor:
         return _pool
 
 
-def _verify_chunk(events) -> None:
+def _verify_chunk(events, enq_ts: float = 0.0,
+                  inst: Optional[QueueInstrument] = None) -> None:
+    if inst is not None:
+        # Submit->start wait: how long the chunk sat behind other
+        # batches in the shared pool before a worker picked it up.
+        inst.observe_wait(time.monotonic() - enq_ts)
     for ev in events:
         try:
             ev.verify()  # memoizes _sig_ok on the event
@@ -89,9 +114,11 @@ def verify_events(events: List, workers: int) -> None:
         _verify_chunk(events)
         return
     pool = _get_pool(workers)
+    inst = _pool_instrument()
     chunk = -(-n // workers)  # ceil
+    t0 = time.monotonic()
     futures = [
-        pool.submit(_verify_chunk, events[i:i + chunk])
+        pool.submit(_verify_chunk, events[i:i + chunk], t0, inst)
         for i in range(0, n, chunk)
     ]
     for f in futures:

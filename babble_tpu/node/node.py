@@ -54,7 +54,16 @@ from ..net.transport import (
     TransportError,
 )
 from ..proxy.proxy import AppProxy
-from ..telemetry import ClusterClock, Registry, SpanRing
+from ..telemetry import (
+    ClusterClock,
+    InstrumentedQueue,
+    QueueInstrument,
+    Registry,
+    SpanRing,
+    get_registry,
+)
+from ..telemetry import profiler as _profiler
+from ..telemetry import threadcpu as _threadcpu
 from .config import Config
 from .control_timer import ControlTimer
 from .core import Core
@@ -80,7 +89,6 @@ class Node:
         self.logger = conf.logger
         self.local_addr = trans.local_addr()
 
-        self.commit_ch: "queue.Queue[Block]" = queue.Queue(400)
         # Telemetry (docs/observability.md): the span ring behind
         # /debug/trace, and this node's metric children behind
         # /metrics. The registry is PER NODE (merged with the
@@ -109,6 +117,16 @@ class Node:
         self._trace_seq = itertools.count(1)
         _nl = str(id)
         reg = self.registry
+        # Saturation observatory (docs/observability.md "Saturation"):
+        # every bounded buffer this node owns reports depth/capacity,
+        # enqueue->dequeue wait, and overflow through one instrumented
+        # channel. The commit channel is the reference's 400-deep
+        # commitCh (node/node.go); full = the consensus thread blocks.
+        self.commit_ch: "queue.Queue[Block]" = InstrumentedQueue(
+            int(getattr(conf, "commit_queue", 400)),
+            QueueInstrument(
+                reg, "commit", int(getattr(conf, "commit_queue", 400)),
+                node=_nl))
         self._m_sync_requests = reg.counter(
             "babble_sync_requests_total",
             "Outbound gossip requests (pull + push legs)", node=_nl)
@@ -290,8 +308,17 @@ class Node:
         self.state.set_starting(True)
 
         self.control_timer = ControlTimer(conf.heartbeat_timeout)
-        self._work: "queue.Queue[tuple]" = queue.Queue()
+        # The serialized work queue was unbounded; bounding it turns a
+        # runaway backlog into measurable backpressure — the forwarders
+        # block (propagating to the transport consumer queues) instead
+        # of the queue growing without a signal.
+        self._work: "queue.Queue[tuple]" = InstrumentedQueue(
+            int(getattr(conf, "work_queue", 4096)),
+            QueueInstrument(
+                self.registry, "work",
+                int(getattr(conf, "work_queue", 4096)), node=_nl))
         self._shutdown = threading.Event()
+        self._profiler_held = False
 
         self.start_time = time.monotonic()
         # Kept only as the shutdown-once guard; the gossip counters it
@@ -364,23 +391,35 @@ class Node:
             self.core.init()
 
     def run_async(self, gossip: bool = True) -> threading.Thread:
-        t = threading.Thread(target=self.run, args=(gossip,), daemon=True)
+        t = threading.Thread(target=self.run, args=(gossip,), daemon=True,
+                             name=f"babble-gossip-{self.id}")
         t.start()
         return t
 
     def run(self, gossip: bool = True) -> None:
         self.start_time = time.monotonic()
+        # Threads are named so the flame profiler and the per-thread
+        # CPU attribution (babble_thread_cpu_seconds_total{thread})
+        # can say who owns the core; the run() driver itself is named
+        # by run_async (or the caller).
+        if getattr(self.conf, "profile_hz", 0.0) > 0 \
+                and not self._profiler_held:
+            _profiler.acquire(self.conf.profile_hz)
+            self._profiler_held = True
         self.control_timer.run()
         if gossip and self.plumtree is not None:
             # Sender/timer threads only exist on a gossiping node — a
             # serve-only node (tests drive it manually) must not push.
             self.plumtree.start()
         self._start_forwarders()
-        self.state.go_func(self._do_background_work)
+        self.state.go_func(self._do_background_work,
+                           name=f"babble-worker-{self.id}")
         if self.conf.consensus_interval > 0:
-            self.state.go_func(self._consensus_loop)
+            self.state.go_func(self._consensus_loop,
+                               name=f"babble-consensus-{self.id}")
         if self.watchdog is not None:
-            self.state.go_func(self._watchdog_loop)
+            self.state.go_func(self._watchdog_loop,
+                               name=f"babble-watchdog-{self.id}")
 
         while True:
             state = self.state.get_state()
@@ -402,7 +441,16 @@ class Node:
             self._shutdown_done = True
         self.state.set_state(NodeState.SHUTDOWN)
         self._shutdown.set()
-        self._work.put(("shutdown", None))
+        if self._profiler_held:
+            _profiler.release()
+            self._profiler_held = False
+        try:
+            # Best-effort wakeup: with _work now bounded, a full queue
+            # must not wedge shutdown — the worker also polls the
+            # _shutdown flag every 0.1 s.
+            self._work.put_nowait(("shutdown", None))
+        except queue.Full:
+            pass
         if self.plumtree is not None:
             self.plumtree.shutdown()
         self.control_timer.shutdown()
@@ -443,11 +491,23 @@ class Node:
                     item = src.get(timeout=0.1)
                 except queue.Empty:
                     continue
-                self._work.put((tag, item))
+                # Bounded put that stays shutdown-responsive: a full
+                # work queue blocks the forwarder (backpressure into
+                # src) but never past the shutdown flag.
+                while not self._shutdown.is_set():
+                    try:
+                        self._work.put((tag, item), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
-        self.state.go_func(lambda: forward(self.net_ch, "rpc"))
-        self.state.go_func(lambda: forward(self.submit_ch, "tx"))
-        self.state.go_func(lambda: forward(self.commit_ch, "block"))
+        nid = self.id
+        self.state.go_func(lambda: forward(self.net_ch, "rpc"),
+                           name=f"babble-fwd-rpc-{nid}")
+        self.state.go_func(lambda: forward(self.submit_ch, "tx"),
+                           name=f"babble-fwd-tx-{nid}")
+        self.state.go_func(lambda: forward(self.commit_ch, "block"),
+                           name=f"babble-fwd-block-{nid}")
 
     def _do_background_work(self) -> None:
         while not self._shutdown.is_set():
@@ -538,7 +598,9 @@ class Node:
                                 addr = peer.net_addr
                                 self.state.go_func(
                                     lambda: self._gossip_bounded(
-                                        addr, slots))
+                                        addr, slots),
+                                    name="babble-gossip-round-"
+                                    f"{self.id}")
                                 spawned = True
                                 if plum:
                                     iv = getattr(
@@ -1539,6 +1601,28 @@ class Node:
               peer=addr).set(h["trips"])
             g("babble_breaker_consecutive_failures",
               peer=addr).set(h["consecutive_failures"])
+        # Saturation plane (docs/observability.md "Saturation"):
+        # per-thread CPU attribution + process utilization gauges live
+        # in the process-global registry (threads are process-scoped,
+        # not per node); the sampler throttles itself so several nodes
+        # refreshing at one scrape pay once.
+        _threadcpu.sample(get_registry())
+
+    def saturation_stats(self) -> Dict[str, dict]:
+        """Per-queue depth/capacity/wait snapshots for the /debug
+        planes — read from the same QueueInstruments /metrics exports
+        (no second bookkeeping path)."""
+        out: Dict[str, dict] = {
+            "commit": self.commit_ch.instrument.snapshot(),
+            "work": self._work.instrument.snapshot(),
+        }
+        net_inst = getattr(self.net_ch, "instrument", None)
+        if net_inst is not None:
+            out["tcp_consumer"] = net_inst.snapshot()
+        if self.plumtree is not None:
+            for addr, snap in self.plumtree.push_window_stats().items():
+                out[f"plumtree_push:{addr}"] = snap
+        return out
 
     def get_stats(self) -> Dict[str, str]:
         self._refresh_telemetry_gauges()
@@ -1811,6 +1895,10 @@ class Node:
             if sync and sync[1]:
                 ent["share_of_sync_wall"] = round(known[1] / sync[1], 4)
             out["known_bookkeeping"] = ent
+        # Saturation columns (docs/observability.md "Saturation"):
+        # queue depth/wait next to the efficiency rows, sourced from
+        # the same QueueInstruments /metrics exports.
+        out["queues"] = self.saturation_stats()
         return out
 
     def gossip_peer_efficiency(self) -> Dict[str, Dict]:
@@ -1833,6 +1921,11 @@ class Node:
                 "bytes_per_new_event": row["bytes_per_new_event"],
                 "new_events_per_sync": row["new_events_per_sync"],
             }
+        # Send-window occupancy + queue-wait columns per peer, from
+        # the saturation accounting (same instruments as /metrics).
+        if self.plumtree is not None:
+            for peer, snap in self.plumtree.push_window_stats().items():
+                out.setdefault(peer, {})["push_window"] = snap
         return out
 
     def get_consensus_health(self) -> Dict[str, object]:

@@ -57,6 +57,7 @@ from ..net.transport import (
     EagerSyncRequest,
     TransportError,
 )
+from ..telemetry import InstrumentedQueue, QueueInstrument
 
 # Digest entry: (creator_id, index, event_hex).
 Digest = Tuple[int, int, str]
@@ -170,9 +171,6 @@ class Plumtree:
         # would still echo every event to its origin one hop later).
         self._addr_by_id: Dict[int, str] = dict(
             getattr(node, "_addr_by_id", {}) or {})
-        # Control jobs (ihave / graft / prune sends) run on a tiny pool
-        # so a slow lazy peer cannot stall the timer loop.
-        self._control: "queue.Queue[tuple]" = queue.Queue(256)
         self._threads: List[threading.Thread] = []
         self._started = False
         self._shutdown = threading.Event()
@@ -196,6 +194,18 @@ class Plumtree:
             "babble_plumtree_shed_events_total",
             "Fresh events dropped from a full per-peer push window "
             "(the peer repairs through the lazy plane)", node=_nl)
+        # Saturation accounting (docs/observability.md "Saturation"):
+        # each per-edge push window reports depth/capacity/wait/drops
+        # through a QueueInstrument (created lazily per peer); sheds
+        # double as queue drops on the same labels. Control jobs
+        # (ihave / graft / prune sends) run on a tiny pool so a slow
+        # lazy peer cannot stall the timer loop — that queue is
+        # instrumented the same way.
+        self._reg = reg
+        self._nl = _nl
+        self._q_inst: Dict[str, QueueInstrument] = {}
+        self._control: "queue.Queue[tuple]" = InstrumentedQueue(
+            256, QueueInstrument(reg, "plumtree_ctl", 256, node=_nl))
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -261,6 +271,37 @@ class Plumtree:
             "push_backlog": pending,
         }
 
+    # -- saturation accounting ---------------------------------------------
+
+    def _window_inst(self, addr: str) -> QueueInstrument:
+        """Get-or-create the push window's QueueInstrument for a peer
+        (depth reads the live buffer at scrape time)."""
+        inst = self._q_inst.get(addr)
+        if inst is None:
+            inst = QueueInstrument(
+                self._reg, "plumtree_push", self.window,
+                node=self._nl, peer=addr)
+            st = self._push.get(addr)
+            if st is not None:
+                inst.set_depth_fn(lambda st=st: len(st.buffer))
+            self._q_inst[addr] = inst
+        return inst
+
+    def push_window_stats(self) -> Dict[str, dict]:
+        """Per-peer send-window occupancy + wait snapshots for the
+        /debug planes — the same instruments /metrics exports."""
+        with self._lock:
+            rows = [(a, len(st.buffer), st.active)
+                    for a, st in self._push.items()]
+        out: Dict[str, dict] = {}
+        for addr, depth, active in rows:
+            snap = self._window_inst(addr).snapshot()
+            snap["depth"] = depth
+            snap["occupancy"] = round(depth / max(1, self.window), 4)
+            snap["eager"] = active
+            out[addr] = snap
+        return out
+
     # -- fresh-event intake (called under the node's core lock) ------------
 
     def enqueue_fresh(self, events: List, exclude_addr: str = "") -> None:
@@ -300,6 +341,7 @@ class Plumtree:
                     # that keeps overflowing is slow, not unlucky.
                     overflow = len(st.buffer) + len(batch) - self.window
                     self._m_shed.inc(overflow)
+                    self._window_inst(addr).record_drop(overflow)
                     st.overflows += 1
                     batch = batch[:max(0, self.window - len(st.buffer))]
                     if st.overflows >= _SHED_OVERFLOWS:
@@ -364,6 +406,8 @@ class Plumtree:
                     # GRAFT re-grows the edge when the peer actually
                     # misses something.
                     self._m_shed.inc(len(st.buffer))
+                    self._window_inst(st.addr).record_drop(
+                        len(st.buffer))
                     self._demote_locked(st.addr)
                     continue
                 expired = 0
@@ -372,10 +416,16 @@ class Plumtree:
                     expired += 1
                 if expired:
                     self._m_shed.inc(expired)
+                    self._window_inst(st.addr).record_drop(expired)
+                oldest = st.buffer[0][0] if st.buffer else 0.0
                 batch = [ev for _, ev in st.buffer[:_MAX_PUSH_BATCH]]
                 st.buffer = st.buffer[_MAX_PUSH_BATCH:]
             if not batch:
                 continue
+            # Window wait of the batch's oldest entry — the per-edge
+            # saturation signal (enqueue -> drain into a push RPC).
+            self._window_inst(st.addr).observe_wait(
+                time.monotonic() - oldest)
             st.last_send = time.monotonic()
             self._send_push(st, batch)
 
@@ -420,6 +470,8 @@ class Plumtree:
             room = self.window - len(st.buffer)
             if room < len(events):
                 self._m_shed.inc(len(events) - max(0, room))
+                self._window_inst(st.addr).record_drop(
+                    len(events) - max(0, room))
                 st.overflows += 1
                 events = events[:max(0, room)]
                 if st.overflows >= _SHED_OVERFLOWS:
@@ -530,13 +582,11 @@ class Plumtree:
     # -- control sends -----------------------------------------------------
 
     def _submit_control(self, job: tuple) -> bool:
-        try:
-            self._control.put_nowait(job)
+        if self._control.put_drop(job):
             return True
-        except queue.Full:
-            self.logger.debug("plumtree control queue full: %s dropped",
-                              job[0])
-            return False
+        self.logger.debug("plumtree control queue full: %s dropped",
+                          job[0])
+        return False
 
     def _control_loop(self) -> None:
         node = self.node

@@ -42,8 +42,10 @@ class StateMachine:
         with self._lock:
             self._starting = starting
 
-    def go_func(self, f: Callable[[], None]) -> None:
-        t = threading.Thread(target=f, daemon=True)
+    def go_func(self, f: Callable[[], None], name: str = None) -> None:
+        # Named threads feed the per-thread CPU attribution and the
+        # flame profiler (telemetry/threadcpu.py, telemetry/profiler.py).
+        t = threading.Thread(target=f, daemon=True, name=name)
         with self._lock:
             self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)

@@ -122,25 +122,27 @@ def _closure_update(la, rb, self_parent, other_parent, creator, index,
     return lax.fori_loop(b0, b1, body, (la, rb))
 
 
-@functools.partial(jax.jit, static_argnames=("rows", "fill"),
-                   donate_argnums=(0,))
+@functools.partial(jax.jit, static_argnames=("rows", "fill"))
 def _pad_rows(a, *, rows, fill):
-    """Grow a device carry by `rows` fill-rows along axis 0 (donated)."""
+    """Grow a device carry by `rows` fill-rows along axis 0. NOT
+    donated: the output buffer is strictly larger than the input, so
+    XLA can never alias them — a donate_argnums here only buys the
+    "donated buffers were not usable" warning on every growth step."""
     pad_shape = (rows,) + a.shape[1:]
     return jnp.concatenate([a, jnp.full(pad_shape, fill, a.dtype)], axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=("cols", "fill", "axis"),
-                   donate_argnums=(0,))
+@functools.partial(jax.jit, static_argnames=("cols", "fill", "axis"))
 def _pad_cols(a, *, cols, fill, axis=-1):
-    """Grow a device carry by `cols` fill-slices along `axis` (donated)."""
+    """Grow a device carry by `cols` fill-slices along `axis`. NOT
+    donated (see _pad_rows: growth outputs can never alias)."""
     axis = axis % a.ndim
     pad_shape = a.shape[:axis] + (cols,) + a.shape[axis + 1:]
     return jnp.concatenate([a, jnp.full(pad_shape, fill, a.dtype)],
                            axis=axis)
 
 
-@functools.partial(jax.jit, static_argnames=("cols",), donate_argnums=(0,))
+@functools.partial(jax.jit, static_argnames=("cols",))
 def _pad_ranks(ranks, len_counted, *, cols):
     """Grow the fd rank cube [n, n, K] -> [n, n, K+cols]. Every counted
     la value is a chain position < K <= t for the new thresholds t, so
@@ -285,6 +287,27 @@ def _tables_update(ranks, chain_la, chain_rb, la, rb, newtab, newpos,
 
     ranks = lax.fori_loop(0, nchunks, chunk, ranks)
     return ranks, chain_la, chain_rb
+
+
+# Non-donating twins of the same-shape carry-update kernels. Donation
+# is a pure buffer-reuse optimization: on a single device the in-place
+# update aliases cleanly, but under a mesh GSPMD may reshard the
+# output, making the donated input unusable — XLA then warns "Some
+# donated buffers were not usable" and copies anyway. Mesh-backed
+# engines select these twins at construction (same pattern as the
+# per-backend _tables_fn pick); single-device engines keep the
+# donating forms and the in-place reuse. The growth kernels
+# (_pad_rows/_pad_cols/_pad_ranks) never donate at all — see
+# _pad_rows — so they need no twin.
+_closure_update_nd = jax.jit(
+    _closure_update.__wrapped__, static_argnames=("n", "block"))
+_ingest_nd = jax.jit(_ingest.__wrapped__, static_argnames=("bp",))
+_chain_ingest_nd = jax.jit(
+    _chain_ingest.__wrapped__, static_argnames=("n", "m"))
+_tables_update_hist_nd = jax.jit(
+    _tables_update_hist.__wrapped__, static_argnames=("n", "m"))
+_tables_update_nd = jax.jit(
+    _tables_update.__wrapped__, static_argnames=("n", "m"))
 
 
 class _FdRows:
@@ -754,8 +777,19 @@ class IncrementalEngine:
         # (FLOP count lower by the batch factor; scatter-add is fine
         # off-TPU).
         backend = jax.default_backend()
-        self._tables_fn = (
-            _tables_update if backend == "tpu" else _tables_update_hist)
+        # Kernel selection: donating forms on a single device (in-place
+        # carry reuse), non-donating twins under a mesh where GSPMD's
+        # resharded outputs make donation unusable (the XLA "donated
+        # buffers were not usable" warning — ROADMAP item).
+        donate = self._mesh is None
+        if backend == "tpu":
+            self._tables_fn = _tables_update if donate else _tables_update_nd
+        else:
+            self._tables_fn = (
+                _tables_update_hist if donate else _tables_update_hist_nd)
+        self._k_closure = _closure_update if donate else _closure_update_nd
+        self._k_ingest = _ingest if donate else _ingest_nd
+        self._k_chain_ingest = _chain_ingest if donate else _chain_ingest_nd
         # Window-floor ceiling: the big floors exist to collapse the
         # fused kernel's compile space on the tunneled TPU, where every
         # distinct static shape stalls the node for tens of seconds.
@@ -1075,7 +1109,7 @@ class IncrementalEngine:
             return jnp.asarray(out)
 
         self._sp_d, self._op_d, self._cr_d, self._idx_d, self._coin_d, \
-            self._rb0_d = _ingest(
+            self._rb0_d = self._k_ingest(
                 self._sp_d, self._op_d, self._cr_d, self._idx_d,
                 self._coin_d, self._rb0_d,
                 slc(sp_h, -1, np.int32),
@@ -1105,7 +1139,7 @@ class IncrementalEngine:
         self._newtab_d = jnp.asarray(newtab)
         self._newpos_d = jnp.asarray(newpos)
         self._new_m = m
-        self._chain_d, self._chain_th, self._chain_tl = _chain_ingest(
+        self._chain_d, self._chain_th, self._chain_tl = self._k_chain_ingest(
             self._chain_d, self._chain_th, self._chain_tl,
             self._newtab_d, self._newpos_d,
             jnp.asarray(newhi), jnp.asarray(newlo), n=n, m=m)
@@ -1305,7 +1339,7 @@ class IncrementalEngine:
 
         # 1. Coordinates: only blocks the frozen prefix doesn't cover.
         nb = (e + self.block - 1) // self.block
-        self._la, self._rb = _closure_update(
+        self._la, self._rb = self._k_closure(
             self._la, self._rb, self._sp_d, self._op_d, pp.cr_d,
             pp.idx_d, self._rb0_d, jnp.int32(self._frozen_blocks),
             jnp.int32(nb), n=n, block=self.block)

@@ -230,6 +230,30 @@ class Service:
                         from_round=from_round,
                         max_rounds=max(1, max_rounds),
                         max_events=max(1, min(max_events, 65536))))
+                elif url.path.rstrip("/") == "/debug/flame":
+                    # In-process flame profile (docs/observability.md
+                    # "Saturation"): folded-stack text loadable in
+                    # speedscope or flamegraph.pl. With the standing
+                    # sampler on (--profile_hz > 0) this renders the
+                    # last N seconds of its ring instantly; otherwise
+                    # it burst-samples inline for N seconds (this
+                    # handler thread sleeps, the node is untouched).
+                    from ..telemetry import profiler as _profiler
+
+                    try:
+                        q = parse_qs(url.query)
+                        secs = float(q.get("seconds", ["1"])[0])
+                        secs = min(max(secs, 0.1), 30.0)
+                    except ValueError:
+                        self._json(400, {"error": "bad seconds"})
+                        return
+                    sampler = _profiler.active()
+                    if sampler is not None:
+                        text = sampler.folded(secs)
+                    else:
+                        text = _profiler.burst_folded(secs)
+                    self._send(200, text.encode(),
+                               "text/plain; charset=utf-8")
                 elif url.path.rstrip("/") == "/debug/profile":
                     # Like the reference's pprof mount, this is an
                     # operator tool: bind service_addr to localhost in
@@ -351,7 +375,8 @@ class Service:
         self._server.serve_forever(poll_interval=0.1)
 
     def serve_async(self) -> None:
-        self._thread = threading.Thread(target=self.serve, daemon=True)
+        self._thread = threading.Thread(target=self.serve, daemon=True,
+                                        name="babble-service")
         self._thread.start()
 
     def close(self) -> None:

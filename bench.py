@@ -354,6 +354,17 @@ def _audit_metrics_scrape(node, phases, file_store=False):
             "babble_gossip_syncs_total",
             "babble_gossip_payload_bytes_total",
             "babble_propagation_latency_seconds",
+            # Saturation observatory (docs/observability.md
+            # "Saturation"): every bounded buffer exports depth/
+            # capacity/wait/drops from boot, and the thread CPU
+            # attribution gauges refresh at scrape.
+            "babble_queue_depth",
+            "babble_queue_capacity",
+            "babble_queue_wait_seconds",
+            "babble_queue_dropped_total",
+            "babble_thread_cpu_seconds_total",
+            "babble_cpu_utilization_cores",
+            "babble_cpu_saturation_ratio",
         ]
         if file_store:
             required.append("babble_store_fsync_seconds")
@@ -378,7 +389,8 @@ def build_host_testnet(n_nodes, engine="host", interval=0.0,
                        heartbeat=0.0015, store="inmem",
                        store_sync="batch", trace_sample=0.0,
                        wire_format="columnar", transport="inmem",
-                       health=True, observatory=True, plumtree=True):
+                       health=True, observatory=True, plumtree=True,
+                       profile_hz=0.0):
     """Construct (but do not start) a localhost testnet of N real
     nodes: signed keys, fully-meshed transports, per-node stores and
     app proxies — the shared builder behind the throughput smoke, the
@@ -449,6 +461,10 @@ def build_host_testnet(n_nodes, engine="host", interval=0.0,
         # since the plumtree PR; plumtree=False is the pull-only
         # baseline (the committed pre-plumtree SOAK ledger's shape).
         conf.plumtree = plumtree
+        # In-process flame profiler (docs/observability.md
+        # "Saturation"): 0 keeps the sampler thread unspawned — the
+        # --profile-overhead A/B drives this.
+        conf.profile_hz = profile_hz
         if store == "file":
             # Durable-path A/B (docs/robustness.md "Crash recovery"):
             # same testnet over WAL-backed FileStores, so the
@@ -474,7 +490,7 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
                                 metrics_scrape=False, trace_sample=0.0,
                                 wire_format="columnar", heartbeat=None,
                                 transport="inmem", health=True,
-                                observatory=True):
+                                observatory=True, profile_hz=0.0):
     """Throughput of a live localhost testnet: N real nodes (threads,
     inmem transport, signed events, full sync protocol) bombarded with
     transactions; returns (committed consensus events/sec during a
@@ -525,7 +541,7 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
         n_nodes, engine=engine, interval=interval, heartbeat=heartbeat,
         store=store, store_sync=store_sync, trace_sample=trace_sample,
         wire_format=wire_format, transport=transport, health=health,
-        observatory=observatory)
+        observatory=observatory, profile_hz=profile_hz)
 
     stop = threading.Event()
     # One process, dozens of pure-Python threads: the default 5 ms GIL
@@ -1028,6 +1044,58 @@ def gossip_overhead(reps=4, bar=0.05):
 # --------------------------------------------------------------------------
 
 
+def profile_overhead(reps=4, bar=0.05):
+    """Interleaved A/B of the in-process flame profiler (same protocol
+    as trace/health/gossip_overhead): `reps` back-to-back pairs of the
+    3-node host smoke, one leg with profile_hz=0 (the sampler thread
+    must never be spawned — sampling-off is a strict no-op) and one at
+    the documented production rate of 99 Hz, where the sampler walks
+    sys._current_frames() under the GIL ~99 times a second. The
+    medians must agree within `bar` (5%) or the exit code fails the CI
+    job."""
+    on_hz = 99.0
+    off_rates, on_rates = [], []
+    payload = {
+        "metric": "profile_overhead_ab",
+        "nodes": 3,
+        "engine": "host",
+        "profile_hz_on": on_hz,
+        "reps": reps,
+    }
+    try:
+        for rep in range(reps):
+            for label, hz, acc in (("off", 0.0, off_rates),
+                                   ("on", on_hz, on_rates)):
+                eps, _ = node_testnet_events_per_sec(
+                    engine="host", n_nodes=3, warm_s=6.0, window_s=8.0,
+                    interval=0.0, warm_gate_events=150, windows=1,
+                    profile_hz=hz)
+                acc.append(eps)
+                log(f"  rep {rep} profiler {label}: {eps:,.1f} ev/s")
+    except Exception as exc:  # noqa: BLE001
+        payload["error"] = str(exc)
+        _emit(payload)
+        return 1
+    off_rates.sort()
+    on_rates.sort()
+    med = lambda xs: (xs[len(xs) // 2] if len(xs) % 2  # noqa: E731
+                      else (xs[len(xs) // 2 - 1] + xs[len(xs) // 2]) / 2)
+    off_med, on_med = med(off_rates), med(on_rates)
+    overhead = 1.0 - on_med / off_med if off_med > 0 else 0.0
+    payload["off_events_per_s"] = [round(x, 1) for x in off_rates]
+    payload["on_events_per_s"] = [round(x, 1) for x in on_rates]
+    payload["off_median"] = round(off_med, 1)
+    payload["on_median"] = round(on_med, 1)
+    payload["overhead_pct"] = round(overhead * 100.0, 2)
+    payload["bar_pct"] = bar * 100.0
+    payload["within_bar"] = overhead <= bar
+    _emit(payload)
+    if overhead > bar:
+        log(f"profiler overhead {overhead:.1%} exceeds the {bar:.0%} bar")
+        return 1
+    return 0
+
+
 def _soak_coverage_probe(nodes, timeout=15.0):
     """Coverage time: wall seconds for node 0's NEXT self-event to
     become known to every node (the known maps are read through the
@@ -1098,6 +1166,41 @@ def gossip_soak_leg(n, wall_s, scrape_s, ts_file, probes=5):
     agg_snap = lambda nd: {  # noqa: E731
         k: c.value for k, c in nd._m_gossip_agg.items()}
 
+    def sat_agg():
+        # Queue saturation across ALL nodes, folded by queue family
+        # (per-peer plumtree_push:<addr> entries collapse into one
+        # row): wait p99 takes the max (the bottleneck criterion),
+        # drops sum, depth/capacity report the worst occupant.
+        out: dict = {}
+        for nd in nodes:
+            for name, s in nd.saturation_stats().items():
+                fam = name.split(":", 1)[0]
+                row = out.setdefault(fam, {
+                    "depth": 0, "capacity": 0, "wait_p99_ms": 0.0,
+                    "dropped": 0, "waits": 0})
+                row["depth"] = max(row["depth"], s.get("depth", 0))
+                row["capacity"] = max(row["capacity"],
+                                      s.get("capacity", 0))
+                if s.get("wait_p99_ms") is not None:
+                    row["wait_p99_ms"] = max(row["wait_p99_ms"],
+                                             s["wait_p99_ms"])
+                row["dropped"] += int(s.get("dropped", 0))
+                row["waits"] += int(s.get("waits", 0))
+        return out
+
+    def cpu_from_samples(samples):
+        # Thread CPU folded by role (babble-worker-3 -> babble-worker,
+        # Thread-42 (handle) -> Thread (handle)) so the curve stays
+        # n-independent; the utilization gauge rides along.
+        import re as _re
+
+        by_role: dict = {}
+        for lb, v in samples.get("babble_thread_cpu_seconds_total", []):
+            role = _re.sub(r"-\d+", "", lb.get("thread", "?"))
+            by_role[role] = round(by_role.get(role, 0.0) + v, 3)
+        util = samples.get("babble_cpu_utilization_cores", [])
+        return by_role, (round(util[0][1], 3) if util else None)
+
     def plumtree_snap():
         out = {"grafts": 0, "prunes": 0, "shed": 0}
         for nd in nodes:
@@ -1167,6 +1270,25 @@ def gossip_soak_leg(n, wall_s, scrape_s, ts_file, probes=5):
                     {"t": now, "n": n, "node": "scrape0"} | scraped)
                     + "\n")
                 rows_written += 1
+                # Saturation curves (docs/observability.md
+                # "Saturation"): per-family queue depth/wait and the
+                # role-folded thread CPU totals, one row each per
+                # scrape tick.
+                ts.write(json.dumps({
+                    "t": now, "n": n, "node": "sat",
+                    "queues": {
+                        fam: {"depth": r["depth"],
+                              "wait_p99_ms": r["wait_p99_ms"],
+                              "dropped": r["dropped"]}
+                        for fam, r in sat_agg().items()},
+                }) + "\n")
+                by_role, util = cpu_from_samples(samples)
+                ts.write(json.dumps({
+                    "t": now, "n": n, "node": "cpu",
+                    "thread_cpu_s": by_role,
+                    "utilization_cores": util,
+                }) + "\n")
+                rows_written += 2
                 for i, nd in enumerate(nodes):
                     snap = agg_snap(nd)
                     ts.write(json.dumps({
@@ -1189,6 +1311,16 @@ def gossip_soak_leg(n, wall_s, scrape_s, ts_file, probes=5):
         for nd in nodes:
             for ph, ent in list(nd.core.phase_ns.items()):
                 phase1[ph] = phase1.get(ph, 0) + ent[1]
+        # End-of-leg saturation summary, harvested while the nodes are
+        # still alive (saturation_stats reads live queue instruments).
+        sat1 = sat_agg()
+        try:
+            with urllib.request.urlopen(
+                    f"http://{svc.addr}/metrics", timeout=10) as r:
+                fsamples, _ = promtext.parse(r.read().decode())
+            cpu_roles, cpu_util = cpu_from_samples(fsamples)
+        except Exception:  # noqa: BLE001
+            cpu_roles, cpu_util = {}, None
     finally:
         _sys.setswitchinterval(old_switch)
         stop.set()
@@ -1263,6 +1395,18 @@ def gossip_soak_leg(n, wall_s, scrape_s, ts_file, probes=5):
     # at low duplicate cost once the tree settles.
     if leg_totals:
         leg["legs"] = leg_totals
+    # Saturation summary (USE-method: which queue is the bottleneck,
+    # where did the CPU-seconds go). wait p99 is the bottleneck
+    # criterion — the queue where enqueued work waited longest.
+    if sat1:
+        leg["queues"] = sat1
+        bq = max(sat1.items(), key=lambda kv: kv[1]["wait_p99_ms"])
+        leg["bottleneck_queue"] = bq[0]
+        leg["queue_wait_p99_ms"] = round(bq[1]["wait_p99_ms"], 2)
+    if cpu_roles:
+        leg["thread_cpu_s"] = cpu_roles
+    if cpu_util is not None:
+        leg["cpu_utilization_cores"] = cpu_util
     if top_sum:
         leg["phase_share"] = {ph: round(v / top_sum, 3)
                               for ph, v in sorted(top.items())}
@@ -1298,14 +1442,40 @@ def gossip_soak():
         prefix="babble-soak-")
     os.makedirs(out_dir, exist_ok=True)
     ts_file = os.path.join(out_dir, "soak_timeseries.jsonl")
+    # Multicore leg (`--cpus K` / SOAK_CPUS): pin the whole testnet
+    # process to K cores so thread CPU attribution and the queue
+    # curves are measured under a known core budget. Pinning is
+    # best-effort — the host may expose fewer cores than asked
+    # (cpus_effective records what the run actually had, and the
+    # ledger keeps both so a 1-core container's numbers are never
+    # mistaken for a 2-core result).
+    cpus_req = None
+    if "--cpus" in sys.argv:
+        try:
+            cpus_req = int(sys.argv[sys.argv.index("--cpus") + 1])
+        except (IndexError, ValueError):
+            log("--cpus needs an integer argument")
+            return 1
+    elif os.environ.get("SOAK_CPUS"):
+        cpus_req = int(os.environ["SOAK_CPUS"])
     payload = {
-        "metric": "gossip_soak",
+        "metric": "gossip_soak_multicore" if cpus_req else "gossip_soak",
         "unit": "events/s",
         "engine": "host",
         "wall_s_per_leg": wall_s,
         "timeseries_jsonl": ts_file,
         "legs": {},
     }
+    if cpus_req:
+        payload["cpus_requested"] = cpus_req
+        if hasattr(os, "sched_setaffinity"):
+            avail = sorted(os.sched_getaffinity(0))
+            os.sched_setaffinity(0, set(avail[:cpus_req]))
+            payload["cpus_effective"] = len(os.sched_getaffinity(0))
+        else:
+            payload["cpus_effective"] = None  # no affinity API here
+        log(f"soak multicore: requested {cpus_req} cpus, "
+            f"effective {payload['cpus_effective']}")
     try:
         # The shared machine-speed yardstick (see bench_compare.py).
         calib_eps, _, _ = host_engine_events_per_sec(64, 5000)
@@ -1329,7 +1499,8 @@ def gossip_soak():
         for k in ("redundancy_ratio", "duplicate_share",
                   "bytes_per_new_event", "propagation_p50_ms",
                   "propagation_p99_ms", "coverage_ms",
-                  "bookkeeping_share", "grafts_per_s", "prunes_per_s"):
+                  "bookkeeping_share", "grafts_per_s", "prunes_per_s",
+                  "queue_wait_p99_ms", "cpu_utilization_cores"):
             if leg.get(k) is not None:
                 payload[f"soak{n}_{k}"] = leg[k]
         # Per-leg redundancy (docs/gossip.md): the eager plane is the
@@ -1843,6 +2014,8 @@ if __name__ == "__main__":
         sys.exit(health_overhead())
     elif "--gossip-overhead" in sys.argv:
         sys.exit(gossip_overhead())
+    elif "--profile-overhead" in sys.argv:
+        sys.exit(profile_overhead())
     elif "--soak" in sys.argv:
         sys.exit(gossip_soak())
     else:

@@ -102,6 +102,12 @@ for _n in (3, 8, 16, 32, 64):
     # Tree churn rides as info: repair storms are diagnosis, not SLO.
     HEADLINES[f"soak{_n}_grafts_per_s"] = "ratio-info"
     HEADLINES[f"soak{_n}_prunes_per_s"] = "ratio-info"
+    # Saturation observatory (docs/observability.md "Saturation"):
+    # bottleneck-queue wait and CPU utilization ride as info — both
+    # swing with scheduler luck and core budget; they exist to NAME
+    # the bottleneck, not to gate it.
+    HEADLINES[f"soak{_n}_queue_wait_p99_ms"] = "latency-info"
+    HEADLINES[f"soak{_n}_cpu_utilization_cores"] = "ratio-info"
 
 YARDSTICK = "host_events_per_s"
 
