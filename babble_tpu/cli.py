@@ -110,6 +110,7 @@ def cmd_run(args) -> int:
             else (0.25 if args.engine == "tpu" else 0.0)),
         pipeline_depth=args.pipeline_depth,
         verify_workers=args.verify_workers,
+        device_verify=args.device_verify,
         engine_prewarm=not args.no_prewarm,
         breaker_threshold=0 if args.no_breaker else args.breaker_threshold,
         breaker_base_backoff=args.breaker_backoff / 1000.0,
@@ -333,6 +334,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "ingest (batches are ECDSA-checked outside "
                          "the core lock; -1 = one worker per core, "
                          "capped at 8; 0/1 = inline serial)")
+    rn.add_argument("--device_verify", action="store_true",
+                    help="verify sync-batch ECDSA signatures on the "
+                         "device (ops/p256.py vmapped JAX kernel) "
+                         "instead of the host pool; verdicts are "
+                         "bit-identical to the host backends; falls "
+                         "back to the host path when JAX is absent")
     rn.add_argument("--no_prewarm", action="store_true",
                     help="skip compiling the engine's cold-start kernel "
                          "ladder at boot (tpu engine)")

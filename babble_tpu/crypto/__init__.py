@@ -7,6 +7,7 @@ from .keys import (
     pub_key_from_bytes_cached,
     sign,
     verify,
+    verify_batch,
     sha256,
 )
 from .pem import PemKey, generate_pem_key, PemDump
@@ -20,6 +21,7 @@ __all__ = [
     "pub_key_from_bytes_cached",
     "sign",
     "verify",
+    "verify_batch",
     "sha256",
     "PemKey",
     "generate_pem_key",

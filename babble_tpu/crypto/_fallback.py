@@ -370,6 +370,104 @@ def verify(pub: PublicKey, digest: bytes, r: int, s: int) -> bool:
     return pt is not None and pt[0] % N == r
 
 
+def _batch_inv_n(values):
+    """Montgomery batched inversion mod N: ONE pow(-1) for the whole
+    batch via prefix products. Every value must be in [1, N) — callers
+    range-check r/s first, and any s in that range is invertible (N is
+    prime)."""
+    acc = 1
+    prefix = []
+    for v in values:
+        prefix.append(acc)
+        acc = acc * v % N
+    inv_acc = pow(acc, -1, N)
+    out = [0] * len(values)
+    for i in range(len(values) - 1, -1, -1):
+        out[i] = inv_acc * prefix[i] % N
+        inv_acc = inv_acc * values[i] % N
+    return out
+
+
+def _dual_window_jac(u1: int, u2: int, qwin):
+    """`_dual_window` without the final affine conversion — batch
+    callers convert the whole batch with one shared inversion."""
+    X, Y, Z = 0, 1, 0
+    started = False
+    for shift in range(252, -4, -4):
+        if started:
+            X, Y, Z = _jac_double(X, Y, Z)
+            X, Y, Z = _jac_double(X, Y, Z)
+            X, Y, Z = _jac_double(X, Y, Z)
+            X, Y, Z = _jac_double(X, Y, Z)
+        n1 = (u1 >> shift) & 0xF
+        if n1:
+            X, Y, Z = _jac_add_affine(X, Y, Z, *_G_WIN[n1])
+            started = True
+        n2 = (u2 >> shift) & 0xF
+        if n2:
+            X, Y, Z = _jac_add_affine(X, Y, Z, *qwin[n2])
+            started = True
+    return X, Y, Z
+
+
+def verify_batch(pubs, digests, sigs):
+    """Batched ECDSA verify (docs/ingest.md "Crypto plane"): verdicts
+    for (pubs[i], digests[i], sigs[i]), identical per item to
+    `verify(pub_key_from_bytes(pubs[i]), digests[i], *sigs[i])` — but
+    the per-signature `pow(s, -1, N)` inversions fuse into ONE
+    Montgomery batched-inversion pass, as do the final Jacobian->affine
+    conversions mod P. `pubs` are 65-byte X9.62 encodings (the wire
+    form, so creator grouping needs no point parsing); verdicts are
+    True/False, or None where the creator point itself is malformed —
+    the error case `verify` never sees because `pub_key_from_bytes`
+    raises first, kept distinct so callers can re-raise serially."""
+    n = len(pubs)
+    verdicts: list = [False] * n
+    # Pass 1: range checks + per-creator window tables (cached across
+    # batches by _q_window's LRU — a sync batch is mostly the same few
+    # creators, so grouping by creator is the cache itself).
+    qwins = [None] * n
+    live = []
+    pub_cache: dict = {}
+    for i in range(n):
+        pub = pubs[i]
+        r, s = sigs[i]
+        got = pub_cache.get(pub)
+        if got is None and pub not in pub_cache:
+            try:
+                pt = pub_key_from_bytes(pub)
+                got = _q_window(pt.x, pt.y)
+            except ValueError:
+                got = None
+            pub_cache[pub] = got
+        if got is None:
+            verdicts[i] = None
+            continue
+        if not (1 <= r < N and 1 <= s < N):
+            continue  # verdict stays False
+        qwins[i] = got
+        live.append(i)
+    if not live:
+        return verdicts
+    # Pass 2: one batched inversion for every live s.
+    ws = _batch_inv_n([sigs[i][1] for i in live])
+    # Pass 3: the dual-window chains, affine-converted together. A
+    # point at infinity (Z=0) would zero the Montgomery prefix product,
+    # so it is substituted with Z=1 and remembered as a rejection.
+    jacs = []
+    at_inf = []
+    for w, i in zip(ws, live):
+        z = int.from_bytes(digests[i], "big") % N
+        r = sigs[i][0]
+        X, Y, Z = _dual_window_jac(z * w % N, r * w % N, qwins[i])
+        at_inf.append(not Z)
+        jacs.append((X, Y, Z) if Z else (0, 1, 1))
+    affs = _batch_to_affine(jacs)
+    for pt, inf, i in zip(affs, at_inf, live):
+        verdicts[i] = (not inf) and pt[0] % N == sigs[i][0]
+    return verdicts
+
+
 # -- SEC1 "EC PRIVATE KEY" PEM --------------------------------------------
 # Minimal DER: exactly the structure Go's x509.MarshalECPrivateKey emits
 # (RFC 5915): SEQ { INT 1, OCTETSTRING d, [0]{OID prime256v1},

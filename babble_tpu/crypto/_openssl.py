@@ -196,6 +196,51 @@ def verify(pub: bytes, digest: bytes, r: int, s: int) -> bool:
         _lib.ECDSA_SIG_free(sig)
 
 
+def verify_batch(pubs, digests, sigs):
+    """Batched verify (docs/ingest.md "Crypto plane"): one EC_KEY
+    lookup per distinct creator for the whole batch — grouping shares
+    the deserialized key and its generator precompute table across the
+    group instead of paying the LRU probe per event — then
+    ECDSA_do_verify per signature (libcrypto has no multi-signature
+    entry point; the win here is key-table reuse and one ctypes
+    call per event instead of three). Verdicts are True/False, or None
+    where the creator point is malformed (the case serial `verify`
+    maps to False via `_ec_key` raising — batch callers need it
+    distinct to re-raise at the serial position)."""
+    n = len(pubs)
+    verdicts: list = [False] * n
+    by_pub: dict = {}
+    for i, pub in enumerate(pubs):
+        by_pub.setdefault(pub, []).append(i)
+    for pub, idxs in by_pub.items():
+        try:
+            holder = _ec_key(pub)
+        except ValueError:
+            for i in idxs:
+                verdicts[i] = None
+            continue
+        for i in idxs:
+            r, s = sigs[i]
+            if not (1 <= r < N and 1 <= s < N):
+                continue
+            sig = _lib.ECDSA_SIG_new()
+            if not sig:
+                raise MemoryError("ECDSA_SIG_new failed")
+            bn_r = _lib.BN_bin2bn(r.to_bytes(32, "big"), 32, None)
+            bn_s = _lib.BN_bin2bn(s.to_bytes(32, "big"), 32, None)
+            if not bn_r or not bn_s or not _lib.ECDSA_SIG_set0(
+                    sig, bn_r, bn_s):
+                _lib.ECDSA_SIG_free(sig)
+                raise MemoryError("ECDSA_SIG assembly failed")
+            try:
+                digest = digests[i]
+                verdicts[i] = _lib.ECDSA_do_verify(
+                    digest, len(digest), sig, holder.ptr) == 1
+            finally:
+                _lib.ECDSA_SIG_free(sig)
+    return verdicts
+
+
 def base_point_x(k: int) -> Optional[int]:
     """x-coordinate of k*G on P-256 (None at infinity) — the one
     expensive step of signing."""

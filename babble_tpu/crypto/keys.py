@@ -88,6 +88,29 @@ if BACKEND == "openssl":
         except Exception:
             return False
 
+    def verify_batch(pubs, digests, sigs):
+        """Batched verify (docs/ingest.md "Crypto plane"): pubs are
+        65-byte X9.62 encodings; verdicts are True/False, or None for a
+        malformed creator point. Grouping by creator shares the parsed
+        EllipticCurvePublicKey (and OpenSSL's per-key precompute)
+        across the group — the wheel exposes no multi-signature verify,
+        so per-signature calls remain."""
+        n = len(pubs)
+        verdicts: list = [False] * n
+        by_pub: dict = {}
+        for i, pub in enumerate(pubs):
+            by_pub.setdefault(pub, []).append(i)
+        for pub, idxs in by_pub.items():
+            try:
+                key = pub_key_from_bytes(pub)
+            except Exception:
+                for i in idxs:
+                    verdicts[i] = None
+                continue
+            for i in idxs:
+                verdicts[i] = verify(key, digests[i], *sigs[i])
+        return verdicts
+
 else:
     generate_key = _fb.generate_key
     key_from_seed = _fb.key_from_seed
@@ -106,9 +129,12 @@ else:
                    r: int, s: int) -> bool:
             return _ossl.verify(pub.to_bytes(), digest, r, s)
 
+        verify_batch = _ossl.verify_batch
+
     else:
         sign = _fb.sign
         verify = _fb.verify
+        verify_batch = _fb.verify_batch
 
 
 @functools.lru_cache(maxsize=1024)

@@ -116,6 +116,14 @@ class Config:
     # < 0 = auto (one worker per core, capped at 8); 0/1 = verify
     # inline on the syncing thread (still outside the lock).
     verify_workers: int = -1
+    # Device-side signature verification (docs/ingest.md "Crypto
+    # plane"): route each sync batch's ECDSA checks to the ops/p256.py
+    # vmapped JAX kernel instead of the host verify pool, overlapping
+    # verification on the device the consensus engine already owns.
+    # Verdicts are parity-pinned bit-for-bit against the host backends.
+    # Off by default (the flag doubles as the kill switch); ingest
+    # silently falls back to the host path when JAX is unavailable.
+    device_verify: bool = False
     # Consensus pipeline depth for the device engine (requires
     # consensus_interval > 0). 0 = synchronous: each worker wake runs
     # dispatch + collect back to back (the host blocks on the device

@@ -21,7 +21,7 @@ from ..hashgraph.event import Event, WireEvent
 from ..hashgraph.graph import Hashgraph
 from ..hashgraph.store import Store
 from ..telemetry import Registry, SpanRing, get_registry
-from .ingest import resolve_verify_workers, verify_events
+from .ingest import active_backend, resolve_verify_workers, verify_events
 
 
 class Core:
@@ -37,6 +37,7 @@ class Core:
         engine_prewarm: bool = False,
         engine_opts: Optional[Dict] = None,
         verify_workers: int = -1,
+        device_verify: bool = False,
         trace: Optional[SpanRing] = None,
         registry: Optional[Registry] = None,
         compile_cache_dir: str = "",
@@ -117,6 +118,12 @@ class Core:
         self.participants = participants
         self.reverse_participants = {pid: pk for pk, pid in participants.items()}
         self.verify_workers = resolve_verify_workers(verify_workers)
+        # Device-side verify (ROADMAP crypto-plane lever 2,
+        # docs/ingest.md "Crypto plane"): route sync-batch ECDSA to the
+        # ops/p256.py vmapped JAX kernel instead of the host pool. Off
+        # by default — the flag is the kill switch — and ingest falls
+        # back to the host path when JAX is absent.
+        self.device_verify = bool(device_verify)
         self.head = ""
         self.seq = -1
         self.transaction_pool: List[bytes] = []
@@ -481,9 +488,17 @@ class Core:
                 self._m_verified.inc(len(to_verify))
                 if unlocked is not None:
                     with unlocked():
-                        verify_events(to_verify, self.verify_workers)
+                        verify_events(to_verify, self.verify_workers,
+                                      self.device_verify)
                 else:
-                    verify_events(to_verify, self.verify_workers)
+                    verify_events(to_verify, self.verify_workers,
+                                  self.device_verify)
+                # Per-backend sub-split of the verify wall
+                # (docs/observability.md "Crypto plane"): same interval
+                # stamped under `verify_<backend>` so /debug/phases
+                # attributes the cost to the backend that paid it.
+                self._timed(
+                    "verify_" + active_backend(self.device_verify), t0)
             self._timed("verify", t0)
             return self._insert_batch(unknown, events, has_event,
                                       wrap_fresh_only)

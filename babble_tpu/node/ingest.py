@@ -1,4 +1,4 @@
-"""Host ingest helpers: the batched signature-verify pool.
+"""Host ingest helpers: the batch-first signature-verify plane.
 
 A sync batch's ECDSA checks are the dominant host cost of the gossip
 ingest path (BENCH_r05: the device engine sustains ~28k ev/s while the
@@ -8,6 +8,15 @@ therefore materializes the whole batch first, then calls
 `verify_events` with the lock RELEASED (node's `_core_unlocked` seam),
 and only re-acquires it for the insert phase.
 
+Batch-first (docs/ingest.md "Crypto plane"): each pool chunk makes ONE
+`crypto.verify_batch` call instead of per-event `verify()` calls, so
+the backend can share per-creator EC_KEY precompute across the chunk
+and — on the pure fallback — fuse every signature's modular inversion
+into a single Montgomery batched-inversion pass. With
+`device_verify=True` the whole batch bypasses the pool and runs on the
+`ops/p256.py` vectorized JAX kernel instead, overlapping host ingest on
+the device the consensus engine already owns.
+
 Worker pool: one process-global ThreadPoolExecutor shared by every
 in-process node (a 16-node localhost testnet must not spawn 16 pools).
 With the `cryptography` backend (OpenSSL) each verify releases the GIL,
@@ -16,11 +25,12 @@ GIL-bound but still gets the chunked path — the win there is that
 verification happens outside the core lock, so the node keeps serving
 syncs and accepting pushes while a batch grinds.
 
-Verification results are memoized on the Event (`Event.verify` caches
-`_sig_ok`), so the engine's own insert-time `verify()` re-check is a
-cache hit, and a worker raising (malformed creator point) leaves the
-memo unset — the insert loop then re-raises the same exception at the
-same batch position the serial path would have.
+Verification results are memoized on the Event (`Event._sig_ok`), so
+the engine's own insert-time `verify()` re-check is a cache hit. A
+malformed creator point yields a `None` verdict from `verify_batch`;
+the memo is left unset, and the insert loop's own `verify()` then
+raises the identical exception at the same batch position the serial
+path would have.
 """
 
 from __future__ import annotations
@@ -28,9 +38,10 @@ from __future__ import annotations
 import os
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
-from typing import List, Optional
+from concurrent.futures import CancelledError, ThreadPoolExecutor
+from typing import Callable, List, Optional
 
+from .. import crypto
 from ..telemetry import get_registry
 from ..telemetry.queues import QueueInstrument
 
@@ -49,15 +60,51 @@ _pool_lock = threading.Lock()
 # depth reads the executor's pending work queue at scrape time.
 _q_inst: Optional[QueueInstrument] = None
 
+# Crypto-plane telemetry (docs/observability.md "Crypto plane"):
+# `babble_verify_backend{backend}` is an info gauge — value 1, the
+# label names the backend actually verifying — and
+# `babble_verify_batch_size` records the size of every batch handed to
+# a backend `verify_batch` call (the number whose distribution tells
+# whether batching amortizes: all-1s means the plane degraded to
+# serial). Process-global like the pool they instrument.
+_batch_hist = None
+_backend_gauges: set = set()
+_metrics_lock = threading.Lock()
 
-def _pool_instrument() -> QueueInstrument:
-    global _q_inst
-    if _q_inst is None:
-        _q_inst = QueueInstrument(
-            get_registry(), "verify_pool", 0,
-            depth_fn=lambda: (_pool._work_queue.qsize()
-                              if _pool is not None else 0))
-    return _q_inst
+
+def _observe_batch(size: int, backend: str) -> None:
+    global _batch_hist
+    with _metrics_lock:
+        if _batch_hist is None:
+            _batch_hist = get_registry().histogram(
+                "babble_verify_batch_size",
+                "Events per backend verify_batch call")
+        if backend not in _backend_gauges:
+            get_registry().gauge(
+                "babble_verify_backend",
+                "Active signature-verify backend (info gauge: value 1, "
+                "label names the backend)", backend=backend).set(1)
+            _backend_gauges.add(backend)
+    _batch_hist.observe(size)
+
+
+def _device_backend() -> Optional[Callable]:
+    """The device kernel's verify_batch, or None when JAX is absent —
+    callers fall back to the host pool path, never fail."""
+    try:
+        from ..ops import p256
+        return p256.verify_batch if p256.available() else None
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def active_backend(device_verify: bool = False) -> str:
+    """Name of the backend `verify_events` would use — the label on
+    the `babble_verify_backend` gauge and the `/debug/phases`
+    `verify_<backend>` sub-split."""
+    if device_verify and _device_backend() is not None:
+        return "device-p256"
+    return crypto.BACKEND
 
 
 def default_verify_workers() -> int:
@@ -87,29 +134,76 @@ def _get_pool(workers: int) -> ThreadPoolExecutor:
         return _pool
 
 
+def verify_batch_events(events, backend: Optional[Callable] = None,
+                        backend_name: str = "") -> None:
+    """Populate `_sig_ok` memos for `events` with ONE backend
+    `verify_batch` call. Verdict contract (docs/ingest.md "Crypto
+    plane"): True/False memoize; None (malformed creator point) leaves
+    the memo unset so the insert loop's `verify()` re-raises the
+    identical exception at the serial path's batch position."""
+    todo = [ev for ev in events if ev._sig_ok is None]
+    if not todo:
+        return
+    fn = backend if backend is not None else crypto.verify_batch
+    _observe_batch(len(todo), backend_name or crypto.BACKEND)
+    verdicts = fn(
+        [ev.body.creator for ev in todo],
+        [ev.body.hash() for ev in todo],
+        [(int(ev.r), int(ev.s)) for ev in todo])
+    for ev, ok in zip(todo, verdicts):
+        if ok is not None:
+            ev._sig_ok = bool(ok)
+
+
 def _verify_chunk(events, enq_ts: float = 0.0,
                   inst: Optional[QueueInstrument] = None) -> None:
+    # Submit->start wait: how long the chunk sat behind other batches
+    # in the shared pool before a worker picked it up. Observed FIRST
+    # so a raising backend still leaves the wait accounted.
     if inst is not None:
-        # Submit->start wait: how long the chunk sat behind other
-        # batches in the shared pool before a worker picked it up.
         inst.observe_wait(time.monotonic() - enq_ts)
-    for ev in events:
-        try:
-            ev.verify()  # memoizes _sig_ok on the event
-        except Exception:  # noqa: BLE001
-            # Leave the memo unset: the insert loop's own verify() will
-            # re-raise the identical exception at the serial path's
-            # position instead of this worker's.
-            pass
+    try:
+        verify_batch_events(events)
+    except Exception:  # noqa: BLE001
+        # Leave the memos unset: the insert loop's own verify() will
+        # re-raise the identical exception at the serial path's
+        # position instead of this worker's.
+        pass
 
 
-def verify_events(events: List, workers: int) -> None:
-    """Populate every event's signature memo, chunked across the shared
-    pool. Returns nothing: outcomes (ok / bad / raising) are delivered
-    through `Event.verify` exactly as the serial path delivers them."""
+def _pool_instrument() -> QueueInstrument:
+    global _q_inst
+    if _q_inst is None:
+        _q_inst = QueueInstrument(
+            get_registry(), "verify_pool", 0,
+            depth_fn=lambda: (_pool._work_queue.qsize()
+                              if _pool is not None else 0))
+    return _q_inst
+
+
+def verify_events(events: List, workers: int,
+                  device_verify: bool = False) -> None:
+    """Populate every event's signature memo. Returns nothing:
+    outcomes (ok / bad / raising) are delivered through `Event.verify`
+    exactly as the serial path delivers them.
+
+    Host path: the batch is chunked across the shared pool, one
+    `crypto.verify_batch` call per chunk. Device path
+    (`device_verify=True`, JAX importable): the WHOLE batch goes to the
+    `ops/p256.py` vmapped kernel in one call — the kernel is internally
+    batch-parallel, so farming chunks to threads would only contend the
+    single device; falls back to the host path when JAX is absent."""
     n = len(events)
     if n == 0:
         return
+    if device_verify:
+        dev = _device_backend()
+        if dev is not None:
+            try:
+                verify_batch_events(events, dev, "device-p256")
+                return
+            except Exception:  # noqa: BLE001
+                pass  # kernel failure -> host path below, same memos
     if workers <= 1 or n < _MIN_POOL_BATCH:
         _verify_chunk(events)
         return
@@ -117,9 +211,17 @@ def verify_events(events: List, workers: int) -> None:
     inst = _pool_instrument()
     chunk = -(-n // workers)  # ceil
     t0 = time.monotonic()
-    futures = [
-        pool.submit(_verify_chunk, events[i:i + chunk], t0, inst)
-        for i in range(0, n, chunk)
-    ]
-    for f in futures:
-        f.result()
+    chunks = [events[i:i + chunk] for i in range(0, n, chunk)]
+    futures = [pool.submit(_verify_chunk, c, t0, inst) for c in chunks]
+    for f, c in zip(futures, chunks):
+        try:
+            f.result()
+        except CancelledError:
+            # The shared pool was replaced/shut down between submit and
+            # pickup (`_get_pool` growth does `shutdown(wait=False)`):
+            # the chunk never ran, so nothing observed its wait. Keep
+            # the accounting honest — observe the queued time and count
+            # the shed — then verify inline with identical semantics.
+            inst.observe_wait(time.monotonic() - t0)
+            inst.record_drop()
+            _verify_chunk(c)

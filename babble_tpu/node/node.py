@@ -224,6 +224,7 @@ class Node:
             engine_prewarm=getattr(conf, "engine_prewarm", False),
             engine_opts=getattr(conf, "engine_opts", None),
             verify_workers=getattr(conf, "verify_workers", -1),
+            device_verify=getattr(conf, "device_verify", False),
             trace=self.trace,
             registry=self.registry,
             compile_cache_dir=getattr(conf, "compile_cache_dir", ""),

@@ -365,6 +365,13 @@ def _audit_metrics_scrape(node, phases, file_store=False):
             "babble_thread_cpu_seconds_total",
             "babble_cpu_utilization_cores",
             "babble_cpu_saturation_ratio",
+            # Crypto plane (docs/observability.md "Crypto plane"):
+            # the backend info gauge and the per-call batch-size
+            # histogram exist as soon as the first sync batch is
+            # ECDSA-checked; verified-event totals from boot.
+            "babble_verify_backend",
+            "babble_verify_batch_size",
+            "babble_verify_events_total",
         ]
         if file_store:
             required.append("babble_store_fsync_seconds")
@@ -621,8 +628,12 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
         ingest = {ph: v for ph, v in tot.items()
                   if ph in ("from_wire", "wire_unpack", "verify",
                             "insert")}
+        # verify_<backend> re-records the verify interval keyed by the
+        # crypto backend (docs/ingest.md "Crypto plane") — keep it out
+        # of every share denominator or verify wall counts twice.
         top = {ph: v for ph, v in tot.items()
                if not ph.startswith("engine_") and ph not in ingest
+               and not ph.startswith("verify_")
                and ph != "store_commit"}
         if top:
             s = sum(top.values())
@@ -1096,6 +1107,95 @@ def profile_overhead(reps=4, bar=0.05):
     return 0
 
 
+def verify_bench(sizes=(1, 8, 64, 512), device_budget_s=150.0):
+    """Crypto-plane microbenchmark (docs/ingest.md "Crypto plane"):
+    per-backend serial vs batch vs device µs/event at batch sizes
+    {1,8,64,512}, emitted as one JSON payload (metric `verify_bench`)
+    whose headline keys bench_compare gates against the committed
+    VERIFY_BENCH.json — a crypto regression fails CI like any other.
+
+    Backends: the active host backend (`crypto.BACKEND`), the
+    pure-python fallback when it is not already active, and the
+    ops/p256.py device kernel when JAX is importable. Serial parses
+    creator keys once outside the timer — the ingest path's
+    `pub_key_from_bytes_cached` amortizes exactly that. The device leg
+    respects `device_budget_s` and records any sizes it skipped (no
+    silent caps; on a CPU-fallback runner the 512-lane kernel alone can
+    cost minutes of XLA compile + run)."""
+    import hashlib
+
+    from babble_tpu import crypto
+    from babble_tpu.crypto import _fallback as fb
+
+    payload = {"metric": "verify_bench", "sizes": list(sizes),
+               "backend_active": crypto.BACKEND}
+    max_n = max(sizes)
+    seeds = (1, 2, 3, 5)
+    keys = [fb.key_from_seed(s) for s in seeds]
+    pubs_b = [fb.pub_key_bytes(k) for k in keys]
+    log(f"signing {max_n}-event corpus ({len(keys)} creators, "
+        f"backend {crypto.BACKEND})")
+    pubs, digests, sigs = [], [], []
+    for i in range(max_n):
+        d = hashlib.sha256(b"verify-bench-%d" % i).digest()
+        pubs.append(pubs_b[i % len(keys)])
+        digests.append(d)
+        sigs.append(crypto.sign(keys[i % len(keys)], d))
+
+    def _serial_host(name, verify_fn, key_of):
+        cache = {p: key_of(p) for p in pubs_b}
+        for s in sizes:
+            reps = max(1, min(8, 256 // s))
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                for i in range(s):
+                    verify_fn(cache[pubs[i]], digests[i], *sigs[i])
+            us = (time.perf_counter() - t0) / (reps * s) * 1e6
+            payload[f"verify_{name}_serial_us_{s}"] = round(us, 2)
+            log(f"  {name} serial n={s}: {us:,.1f} us/ev")
+
+    def _batch(name, batch_fn, budget_s=None):
+        t_leg = time.monotonic()
+        for s in sizes:
+            if budget_s is not None and \
+                    time.monotonic() - t_leg > budget_s:
+                skipped = [x for x in sizes if x >= s]
+                payload[f"verify_{name}_sizes_skipped"] = skipped
+                log(f"  {name} batch: budget exhausted, "
+                    f"skipping sizes {skipped}")
+                break
+            reps = max(1, min(8, 256 // s))
+            batch_fn(pubs[:s], digests[:s], sigs[:s])  # warm (compile)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                batch_fn(pubs[:s], digests[:s], sigs[:s])
+            us = (time.perf_counter() - t0) / (reps * s) * 1e6
+            payload[f"verify_{name}_batch_us_{s}"] = round(us, 2)
+            log(f"  {name} batch n={s}: {us:,.1f} us/ev")
+
+    _serial_host(crypto.BACKEND, crypto.verify,
+                 crypto.pub_key_from_bytes)
+    _batch(crypto.BACKEND, crypto.verify_batch)
+    if crypto.BACKEND != "pure-python":
+        _serial_host("pure-python", fb.verify, fb.pub_key_from_bytes)
+        _batch("pure-python", fb.verify_batch)
+
+    try:
+        from babble_tpu.ops import p256
+        device_ok = p256.available()
+    except Exception:  # noqa: BLE001
+        device_ok = False
+    if device_ok:
+        _batch("device-p256", p256.verify_batch,
+               budget_s=device_budget_s)
+    else:
+        payload["device_skipped"] = "jax unavailable"
+        log("  device-p256: skipped (jax unavailable)")
+
+    _emit(payload)
+    return 0
+
+
 def _soak_coverage_probe(nodes, timeout=15.0):
     """Coverage time: wall seconds for node 0's NEXT self-event to
     become known to every node (the known maps are read through the
@@ -1350,8 +1450,12 @@ def gossip_soak_leg(n, wall_s, scrape_s, ts_file, probes=5):
     # denominators as node_testnet_events_per_sec).
     dphase = {ph: phase1.get(ph, 0) - phase0.get(ph, 0) for ph in phase1}
     ingest = ("from_wire", "wire_unpack", "verify", "insert")
+    # verify_<backend> is the same interval as verify under a
+    # backend-keyed name — excluded so the verify wall isn't counted
+    # twice in the pacing denominator.
     top = {ph: v for ph, v in dphase.items()
            if not ph.startswith("engine_") and ph not in ingest
+           and not ph.startswith("verify_")
            and ph != "store_commit" and v > 0}
     top_sum = sum(top.values())
     leg = {
@@ -2016,6 +2120,8 @@ if __name__ == "__main__":
         sys.exit(gossip_overhead())
     elif "--profile-overhead" in sys.argv:
         sys.exit(profile_overhead())
+    elif "--verify-bench" in sys.argv:
+        sys.exit(verify_bench())
     elif "--soak" in sys.argv:
         sys.exit(gossip_soak())
     else:

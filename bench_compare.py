@@ -109,6 +109,21 @@ for _n in (3, 8, 16, 32, 64):
     HEADLINES[f"soak{_n}_queue_wait_p99_ms"] = "latency-info"
     HEADLINES[f"soak{_n}_cpu_utilization_cores"] = "ratio-info"
 
+# Crypto-plane microbenchmark (bench.py --verify-bench, docs/ingest.md
+# "Crypto plane"): per-backend µs/event, lower-better. The HOST batch
+# numbers gate (they are the ingest path's actual cost; a libcrypto or
+# Montgomery-pass regression fails CI here); serial numbers ride as
+# info (they exist to show the batch speedup, not to be an SLO), and
+# the device kernel rides as info too — on a CPU-fallback runner its
+# absolute cost is an XLA artifact, and parity (not speed) is the
+# device gate, enforced by tests/test_p256.py.
+for _b in ("openssl", "openssl-ctypes", "pure-python"):
+    for _s in (1, 8, 64, 512):
+        HEADLINES[f"verify_{_b}_serial_us_{_s}"] = "latency-info"
+        HEADLINES[f"verify_{_b}_batch_us_{_s}"] = "latency"
+for _s in (1, 8, 64, 512):
+    HEADLINES[f"verify_device-p256_batch_us_{_s}"] = "latency-info"
+
 YARDSTICK = "host_events_per_s"
 
 

@@ -113,6 +113,109 @@ def test_openssl_ctypes_accelerator_parity():
         assert ossl.base_point_x(k) == fb._mult_base(k)[0]
 
 
+# ---------------------------------------------------- batched verify
+
+
+def _batch_vectors():
+    """Mixed parity corpus for verify_batch (docs/ingest.md "Crypto
+    plane"): valid signatures from repeated creators (exercises the
+    per-creator grouping), a corrupted s, a high-s encoding (N - s is
+    an equally valid ECDSA signature), r >= N and r = 0 range
+    rejections, and a malformed creator point (None verdict). Returns
+    (pubs, digests, sigs, expected)."""
+    from babble_tpu.crypto import _fallback as fb
+
+    keys = [fb.key_from_seed(s) for s in (11, 12, 13)]
+    pubs_b = [fb.pub_key_bytes(k) for k in keys]
+    pubs, digests, sigs, expected = [], [], [], []
+    for i in range(6):
+        k = keys[i % 3]
+        d = crypto.sha256(b"batch-%d" % i)
+        r, s = fb.sign(k, d)
+        ok = True
+        if i == 2:
+            s = (s + 1) % fb.N or 1  # corrupted at position 2
+            ok = False
+        if i == 4:
+            s = fb.N - s  # high-s: still a valid signature
+        pubs.append(pubs_b[i % 3])
+        digests.append(d)
+        sigs.append((r, s))
+        expected.append(ok)
+    # range rejections on a valid digest
+    d = crypto.sha256(b"range")
+    r, s = fb.sign(keys[0], d)
+    pubs += [pubs_b[0], pubs_b[0]]
+    digests += [d, d]
+    sigs += [(fb.N + 5, s), (0, s)]
+    expected += [False, False]
+    # malformed creator point: verdict None (the ingest path leaves
+    # the memo unset and re-raises serially)
+    pubs.append(b"\x04" + b"\x00" * 64)
+    digests.append(d)
+    sigs.append((r, s))
+    expected.append(None)
+    return pubs, digests, sigs, expected
+
+
+def test_verify_batch_fallback_parity():
+    """Pure-python verify_batch (Montgomery-fused inversions) agrees
+    with the serial verifier at every batch position."""
+    from babble_tpu.crypto import _fallback as fb
+
+    pubs, digests, sigs, expected = _batch_vectors()
+    assert fb.verify_batch(pubs, digests, sigs) == expected
+    # serial cross-check, position by position
+    for pub, d, (r, s), exp in zip(pubs, digests, sigs, expected):
+        if exp is None:
+            continue
+        assert fb.verify(fb.pub_key_from_bytes(pub), d, r, s) is exp
+
+
+def test_verify_batch_openssl_ctypes_parity():
+    """The ctypes batch path (shared EC_KEY per creator) returns the
+    identical verdict list."""
+    from babble_tpu.crypto import _openssl as ossl
+
+    if not ossl.available():
+        import pytest
+
+        pytest.skip("system libcrypto not loadable")
+    pubs, digests, sigs, expected = _batch_vectors()
+    assert ossl.verify_batch(pubs, digests, sigs) == expected
+
+
+def test_verify_batch_module_dispatch():
+    """The active backend's module-level crypto.verify_batch agrees
+    with the serial module-level verifier."""
+    pubs, digests, sigs, expected = _batch_vectors()
+    assert crypto.verify_batch(pubs, digests, sigs) == expected
+
+
+def test_verify_batch_identity_point_rejection():
+    """Shamir-trick degeneracies: with the d=1 key (Q = G),
+    r = (N - z) mod N drives u1*G + u2*Q to the point at infinity —
+    the verifier must reject, not crash — and r = z mod N makes
+    u1 == u2, forcing the add's doubling branch. Both backends agree."""
+    from babble_tpu.crypto import _fallback as fb
+    from babble_tpu.crypto import _openssl as ossl
+
+    k1 = fb.key_from_seed(0)
+    assert k1.d == 1  # Q == G
+    pub = fb.pub_key_bytes(k1)
+    d = crypto.sha256(b"degenerate")
+    z = int.from_bytes(d, "big") % fb.N
+    r_inf = (fb.N - z) % fb.N or 1
+    r_dbl = z or 1
+    sigs = [(r_inf, 1), (r_dbl, 1)]
+    expected = fb.verify_batch([pub, pub], [d, d], sigs)
+    assert expected[0] is False  # infinity is a rejection
+    for pub_i, d_i, (r, s), exp in zip([pub, pub], [d, d], sigs, expected):
+        assert fb.verify(fb.pub_key_from_bytes(pub_i), d_i, r, s) is exp
+    if ossl.available():
+        assert ossl.verify_batch([pub, pub], [d, d], sigs) == expected
+
+
 def test_pure_crypto_env_kill_switch(tmp_path):
     """BABBLE_PURE_CRYPTO=1 must pin BACKEND to pure-python (CI's
     no-optional-deps job relies on it to keep the fallback exercised)."""

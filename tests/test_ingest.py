@@ -234,6 +234,68 @@ def test_batch_verify_failure_matches_serial_outcome():
             assert cores[2].known()[pid] == idx
 
 
+@pytest.mark.parametrize(
+    "backend", ["pure-python", "openssl-ctypes", "device-p256"])
+def test_batch_verify_failure_position_per_backend(backend, monkeypatch):
+    """Cross-backend failure-position parity (docs/ingest.md "Crypto
+    plane"): whichever backend fills the batch's signature memos, a
+    signature corrupted at batch position k must surface as the serial
+    path's InsertError at the same position — prefix inserted, nothing
+    after, head untouched."""
+    from babble_tpu.crypto import _fallback as fb
+
+    if backend == "pure-python":
+        fn = fb.verify_batch
+    elif backend == "openssl-ctypes":
+        from babble_tpu.crypto import _openssl as ossl
+
+        if not ossl.available():
+            pytest.skip("system libcrypto not loadable")
+        fn = ossl.verify_batch
+    else:
+        jax = pytest.importorskip("jax")  # noqa: F841
+        from babble_tpu.ops import p256
+
+        fn = p256.verify_batch
+
+    import babble_tpu.node.ingest as ingest
+
+    monkeypatch.setattr(ingest.crypto, "verify_batch", fn)
+
+    cores = init_cores(3)
+    _ping_pong(cores, 4)
+    stale = {pid: -1 for pid in cores[2].known()}
+    # A topological-order prefix is parent-closed; 8 events keep the
+    # device kernel on its single compiled 8-lane ladder.
+    wire = cores[0].to_wire(cores[0].diff(stale))[:8]
+    assert len(wire) == 8
+    bad_at = 5
+    tampered = list(wire)
+    tampered[bad_at] = WireEvent(
+        wire[bad_at].body, int(wire[bad_at].r) ^ 1, int(wire[bad_at].s))
+
+    head_before, seq_before = cores[2].head, cores[2].seq
+    with pytest.raises(InsertError, match="Invalid signature"):
+        cores[2].sync(tampered)
+
+    # Serial reference on a fresh replica of the same playbook.
+    ref = init_cores(3)
+    _ping_pong(ref, 4)
+    ref_wire = list(ref[0].to_wire(ref[0].diff(stale))[:8])
+    ref_wire[bad_at] = WireEvent(
+        ref_wire[bad_at].body, int(ref_wire[bad_at].r) ^ 1,
+        int(ref_wire[bad_at].s))
+    with pytest.raises(InsertError, match="Invalid signature"):
+        for we in ref_wire:
+            ev = ref[2].hg.read_wire_info(we)
+            if not ref[2].hg.store.has_event(ev.hex()):
+                ref[2].insert_event(ev, False)
+
+    assert cores[2].known() == ref[2].known()
+    assert cores[2].head == head_before
+    assert cores[2].seq == seq_before
+
+
 def test_bad_push_feeds_breaker_same_as_serial():
     """A tampered eager-sync batch must surface as a failed push to the
     sender — the outcome the peer's circuit breaker is fed — exactly
@@ -274,10 +336,10 @@ def test_verify_runs_outside_core_lock(monkeypatch):
     release = threading.Event()
     real_verify = core_mod.verify_events
 
-    def blocking_verify(events, workers):
+    def blocking_verify(events, workers, device_verify=False):
         started.set()
         assert release.wait(timeout=10.0), "verify window never released"
-        real_verify(events, workers)
+        real_verify(events, workers, device_verify)
 
     monkeypatch.setattr(core_mod, "verify_events", blocking_verify)
     try:

@@ -91,6 +91,51 @@ def test_instrumented_queue_unbounded_capacity_zero():
     assert snap["dropped"] == 0
 
 
+def test_verify_pool_cancelled_chunks_keep_wait_accounting(monkeypatch):
+    """Shutdown-drain instrument gap (ISSUE 16 satellite): chunks
+    cancelled between submit and pickup (the shared pool is replaced
+    with `shutdown(wait=False)` when it grows) must NOT vanish from
+    `babble_queue_wait_seconds` — verify_events observes their queued
+    wait, counts them as drops, and verifies them inline so the memos
+    still land."""
+    from concurrent.futures import Future
+
+    from babble_tpu import crypto
+    from babble_tpu.hashgraph.event import Event
+    from babble_tpu.node import ingest
+
+    key = crypto.key_from_seed(321)
+    pub = crypto.pub_key_bytes(key)
+    events = []
+    for i in range(16):
+        ev = Event.new([b"sat-%d" % i], ["p0", "p1"], pub, i)
+        ev.sign(key)
+        ev._sig_ok = None  # drop sign()'s memo: force real verification
+        events.append(ev)
+    events[3].r = int(events[3].r) ^ 1  # one bad memo expected
+
+    class CancellingPool:
+        def submit(self, fn, *args):
+            f = Future()
+            f.cancel()  # never picked up: the shutdown-drain shape
+            return f
+
+    monkeypatch.setattr(ingest, "_get_pool",
+                        lambda workers: CancellingPool())
+    inst = ingest._pool_instrument()
+    before = inst.snapshot()
+
+    ingest.verify_events(events, workers=4)
+
+    after = inst.snapshot()
+    n_chunks = 4  # 16 events / 4 workers
+    assert after["waits"] == before["waits"] + n_chunks
+    assert after["dropped"] == before["dropped"] + n_chunks
+    # The cancelled chunks were still verified (inline fallback).
+    verdicts = [ev._sig_ok for ev in events]
+    assert verdicts == [True] * 3 + [False] + [True] * 12
+
+
 # ------------------------------------------------------- profiler
 
 
