@@ -131,6 +131,13 @@ def cmd_run(args) -> int:
         ihave_interval=args.ihave_interval / 1000.0,
         graft_timeout=args.graft_timeout / 1000.0,
         anti_entropy_interval=args.anti_entropy_interval / 1000.0,
+        admission=not args.no_admission,
+        intake_queue=args.intake_queue,
+        ingress_target_delay=args.ingress_target_ms / 1000.0,
+        quota_rate=args.quota_rate,
+        quota_burst=args.quota_burst,
+        submit_token=args.submit_token,
+        journal_sync=args.journal_sync,
         logger=logger,
     )
 
@@ -159,7 +166,7 @@ def cmd_run(args) -> int:
     )
 
     if args.journal:
-        proxy = FileAppProxy(args.journal)
+        proxy = FileAppProxy(args.journal, sync=args.journal_sync)
     elif args.no_client:
         proxy = InmemAppProxy()
     else:
@@ -380,6 +387,41 @@ def build_parser() -> argparse.ArgumentParser:
                          "for the tpu engine (restart-surviving kernel "
                          "compiles; default ~/.cache/babble_tpu/jax or "
                          "$JAX_COMPILATION_CACHE_DIR)")
+    # -- ingress (docs/ingress.md) --------------------------------------
+    rn.add_argument("--no_admission", action="store_true",
+                    help="disable the ingress admission plane "
+                         "(per-client quotas, CoDel load shedding, "
+                         "the bounded intake queue, and /subscribe) "
+                         "and restore the bare pre-ingress intake "
+                         "path byte-for-byte")
+    rn.add_argument("--intake_queue", type=int, default=8192,
+                    help="capacity of the bounded intake queue "
+                         "between the HTTP tier and the consensus "
+                         "work queue (babble_queue_*{queue=intake})")
+    rn.add_argument("--ingress_target_ms", type=int, default=200,
+                    help="CoDel target sojourn in milliseconds: "
+                         "standing pipeline delay above this for a "
+                         "full control interval sheds new submissions "
+                         "with 429 + Retry-After until delay recovers")
+    rn.add_argument("--quota_rate", type=float, default=0.0,
+                    help="per-client submission quota in tx/s (token "
+                         "bucket keyed by the X-Babble-Client header, "
+                         "falling back to the remote address); 0 = "
+                         "unlimited")
+    rn.add_argument("--quota_burst", type=float, default=0.0,
+                    help="token-bucket burst capacity; 0 = auto "
+                         "(2s of --quota_rate, floor 64)")
+    rn.add_argument("--submit_token", default="",
+                    help="bearer token required on POST /submit* "
+                         "(constant-time compare, 401 JSON on "
+                         "mismatch); empty = open intake behind the "
+                         "documented localhost binding")
+    rn.add_argument("--journal_sync", default="batch",
+                    choices=["always", "batch"],
+                    help="journal app proxy fsync policy: always = "
+                         "fsync every committed block; batch = one "
+                         "fsync per drained commit burst (kill-safe "
+                         "either way, same family as --store_sync)")
     # -- fault tolerance (docs/robustness.md) ---------------------------
     rn.add_argument("--breaker_threshold", type=int, default=3,
                     help="consecutive sync failures before a peer's "

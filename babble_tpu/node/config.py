@@ -233,6 +233,53 @@ class Config:
     # propagating backpressure to the transport consumer queues
     # instead of growing an unbounded backlog.
     work_queue: int = 4096
+    # -- ingress (docs/ingress.md) -------------------------------------
+    # The admission plane in front of transaction intake: per-client
+    # token-bucket quotas, a CoDel-style adaptive load shedder driven
+    # by the live queue sojourn gauges, the bounded instrumented
+    # intake queue, and the /subscribe commit-notification registry.
+    # False (--no_admission kill switch) restores the bare pre-ingress
+    # intake byte-for-byte: /submit feeds submit_ch directly, no
+    # quotas, no shedding, no subscriptions.
+    admission: bool = True
+    # Capacity of the bounded intake queue between the HTTP tier and
+    # the work queue (exported as babble_queue_*{queue="intake"}).
+    # Full = the shed counter ticks (reason intake_full), never an
+    # unbounded buffer.
+    intake_queue: int = 8192
+    # CoDel target sojourn (seconds): standing pipeline delay (oldest
+    # entry across intake/work/commit_ch) above this for a full
+    # interval starts shedding with 429 + Retry-After; delay back
+    # under target stops it. Not a fixed depth cap — burst absorption
+    # is free, only *standing* delay sheds.
+    ingress_target_delay: float = 0.2
+    # CoDel control interval (seconds): how long delay must stand
+    # above target before the first shed, and the base of the
+    # interval/sqrt(n) shed ramp.
+    ingress_interval: float = 0.5
+    # Per-client submission quota (transactions/second, token bucket
+    # keyed by the X-Babble-Client header falling back to the remote
+    # address). 0 = unlimited (no quota plane).
+    quota_rate: float = 0.0
+    # Token-bucket burst capacity. 0 = auto (2s of quota_rate,
+    # floor 64).
+    quota_burst: float = 0.0
+    # Optional bearer token for POST /submit*: when set, requests
+    # must carry "Authorization: Bearer <token>" (constant-time
+    # compare; 401 JSON on mismatch). Empty = open intake (the
+    # documented localhost-binding guard).
+    submit_token: str = ""
+    # Max concurrent parked /subscribe waiters; beyond it the
+    # endpoint sheds (reason "subscribers") instead of accumulating
+    # blocked handler threads.
+    subscribe_cap: int = 256
+    # FileAppProxy journal fsync policy (--journal): "always" fsyncs
+    # every committed block; "batch" (default) fsyncs when the commit
+    # burst drains — one fsync per intake batch, same policy family
+    # as store_sync. Both are torn-tail-safe under kill -9 (the
+    # journal write+flush lands in the page cache); "always" adds
+    # power-loss durability per block.
+    journal_sync: str = "batch"  # "always" | "batch"
     # Stall watchdog: when payload events are pending but no consensus
     # round has decided for this many seconds, emit a diagnosis (which
     # round is stuck, which witnesses are undecided, which creators

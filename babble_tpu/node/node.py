@@ -321,6 +321,17 @@ class Node:
         self._shutdown = threading.Event()
         self._profiler_held = False
 
+        # Ingress armor (docs/ingress.md): quota -> CoDel shedder ->
+        # bounded intake queue in front of the pipeline, plus the
+        # /subscribe commit-notification registry. --no_admission
+        # leaves it None and the service reverts to the bare intake
+        # path (submit_ch direct) byte-for-byte.
+        self.ingress = None
+        if getattr(conf, "admission", True):
+            from ..service.ingress import Ingress
+
+            self.ingress = Ingress(self, conf)
+
         self.start_time = time.monotonic()
         # Kept only as the shutdown-once guard; the gossip counters it
         # used to protect live in the registry now (one tiny lock per
@@ -481,6 +492,7 @@ class Node:
                     self._commit(item)
                 except Exception as exc:  # noqa: BLE001
                     self.logger.error("shutdown commit failed: %s", exc)
+        self._flush_proxy()
         self.core.hg.store.close()
 
     # -- background work ---------------------------------------------------
@@ -509,6 +521,39 @@ class Node:
                            name=f"babble-fwd-tx-{nid}")
         self.state.go_func(lambda: forward(self.commit_ch, "block"),
                            name=f"babble-fwd-block-{nid}")
+        if self.ingress is not None:
+            self.state.go_func(self._intake_loop,
+                               name=f"babble-intake-{nid}")
+
+    def _intake_loop(self) -> None:
+        """Drain the admission plane's intake queue into the work
+        queue in coalesced batches: one ("txs", [...]) work item —
+        one core_lock acquisition, one journal fsync window — per
+        burst instead of one per transaction. Backpressure is the
+        same as the other forwarders: a full work queue blocks this
+        thread, the intake queue backs up, and the admission
+        controller reads that standing delay as its shed signal."""
+        intake = self.ingress.intake
+        limit = self.ingress.FORWARD_BATCH
+        while not self._shutdown.is_set():
+            try:
+                tx = intake.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [tx]
+            while len(batch) < limit:
+                try:
+                    batch.append(intake.get_nowait())
+                except queue.Empty:
+                    break
+            while not self._shutdown.is_set():
+                try:
+                    self._work.put(("txs", batch), timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            if not self.control_timer.set:
+                self.control_timer.reset()
 
     def _do_background_work(self) -> None:
         while not self._shutdown.is_set():
@@ -524,13 +569,31 @@ class Node:
                 self._add_transaction(item)
                 if not self.control_timer.set:
                     self.control_timer.reset()
+            elif tag == "txs":
+                self._add_transactions(item)
+                if not self.control_timer.set:
+                    self.control_timer.reset()
             elif tag == "block":
                 try:
                     self._commit(item)
                 except Exception as exc:  # noqa: BLE001 - keep the loop alive
                     self.logger.error("commit failed: %s", exc)
+                if self._work.qsize() == 0 and self.commit_ch.qsize() == 0:
+                    # The commit burst drained: one coalesced journal
+                    # fsync for the whole burst (FileAppProxy under
+                    # journal_sync=batch; a no-op for other proxies).
+                    self._flush_proxy()
             elif tag == "shutdown":
                 return
+
+    def _flush_proxy(self) -> None:
+        flush = getattr(self.proxy, "flush", None)
+        if flush is None:
+            return
+        try:
+            flush()
+        except Exception as exc:  # noqa: BLE001 - keep the worker alive
+            self.logger.error("proxy flush failed: %s", exc)
 
     # -- the babbling loop -------------------------------------------------
 
@@ -1433,6 +1496,11 @@ class Node:
                     self._m_commit_latency.observe(now - t0)
         self._m_blocks.inc()
         self._m_txs_committed.inc(len(txs))
+        if self.ingress is not None and txs:
+            # Wake /subscribe waiters and record the digests in the
+            # recently-committed ring (bootstrap replay routes through
+            # here too, so a restarted node resolves old digests).
+            self.ingress.resolve_block(block)
         self._commits_delivered += 1
         if self._crash_after_commits and \
                 self._commits_delivered >= self._crash_after_commits:
@@ -1495,12 +1563,44 @@ class Node:
             self.core.add_transactions(
                 [tx], trace_ids={tx: tid} if tid else None)
 
+    def _add_transactions(self, txs: List[bytes]) -> None:
+        """Batched pool insert for the intake forwarder: the whole
+        coalesced burst is stamped and inserted under ONE core_lock
+        acquisition — the batching win the ingress tier exists for."""
+        for tx in txs:
+            self._stamp_tx(tx)
+        self._m_txs_submitted.inc(len(txs))
+        tids = None
+        if self._tx_trace_ids:
+            tids = {tx: tid for tx in txs
+                    if (tid := self._tx_trace_ids.get(tx, 0))}
+        with self.core_lock:
+            self.core.add_transactions(list(txs), trace_ids=tids or None)
+
     def submit_tx(self, tx: bytes) -> None:
         """Convenience for in-process callers (tests, demos, POST
         /submit). Stamped at intake so the commit-latency histogram
         includes the submit-queue wait."""
         self._stamp_tx(tx)
         self.submit_ch.put(tx)
+
+    def submit_batch(self, txs: List[bytes],
+                     client: str = "") -> Dict[str, object]:
+        """Admission-controlled batch intake (docs/ingress.md): quota
+        -> CoDel shedder -> bounded intake queue. Falls back to plain
+        submit_tx per tx when the admission plane is off
+        (--no_admission), reporting everything accepted."""
+        if self.ingress is None:
+            for tx in txs:
+                self.submit_tx(tx)
+            from ..service.ingress import tx_digest
+
+            return {"accepted": len(txs), "shed": 0,
+                    "quota_rejected": 0,
+                    "digests": [tx_digest(tx) for tx in txs],
+                    "statuses": ["accepted"] * len(txs),
+                    "retry_after": 0}
+        return self.ingress.submit(client, txs)
 
     # -- observability -----------------------------------------------------
 
@@ -1560,6 +1660,14 @@ class Node:
         g("babble_undetermined_events").set(
             len(core.get_undetermined_events()))
         g("babble_transaction_pool").set(len(core.transaction_pool))
+        if self.ingress is not None:
+            g("babble_ingress_subscribers",
+              "Parked /subscribe waiters").set(
+                self.ingress.subscriptions.waiter_count())
+            g("babble_ingress_shedding",
+              "1 while the CoDel admission controller is in a "
+              "shedding episode").set(
+                1 if self.ingress.controller.state()["shedding"] else 0)
         g("babble_engine_backlog").set(core.engine_backlog())
         engine_codes = {"host": 0, "device": 1, "failed_over": 2}
         g("babble_engine_state", "0=host 1=device 2=failed_over").set(
@@ -1617,6 +1725,8 @@ class Node:
             "commit": self.commit_ch.instrument.snapshot(),
             "work": self._work.instrument.snapshot(),
         }
+        if self.ingress is not None:
+            out["intake"] = self.ingress.intake.instrument.snapshot()
         net_inst = getattr(self.net_ch, "instrument", None)
         if net_inst is not None:
             out["tcp_consumer"] = net_inst.snapshot()

@@ -18,6 +18,8 @@ that loads directly in Perfetto — see docs/observability.md."""
 
 from __future__ import annotations
 
+import base64
+import hmac
 import json
 import os
 import tempfile
@@ -26,9 +28,17 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-# /submit has no authentication (localhost-binding is the documented
-# guard), so at least bound what one request can make the node buffer.
+from .ingress import TX_BATCH_MAGIC, decode_tx_batch
+
+# /submit defaults to no authentication (localhost-binding is the
+# documented guard; --submit_token adds a bearer token), so at least
+# bound what one request can make the node buffer. The cap is enforced
+# while READING, not just against Content-Length — a chunked or
+# lying-length client cannot make the handler buffer past it.
 _MAX_SUBMIT_BYTES = 1 << 20
+# A /submit/batch body may carry many transactions; each tx stays
+# under _MAX_SUBMIT_BYTES, the frame under this.
+_MAX_BATCH_BYTES = 8 << 20
 
 
 class Service:
@@ -45,7 +55,7 @@ class Service:
             # (the /Stats handler sent three CORS headers, the rest
             # one, 404s none and an empty body that scrapers read as
             # "server up, metric gone").
-            def _send(self, code, body, content_type):
+            def _send(self, code, body, content_type, extra=None):
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Access-Control-Allow-Origin", "*")
@@ -55,14 +65,17 @@ class Service:
                 self.send_header(
                     "Access-Control-Allow-Headers",
                     "Accept, Content-Type, Content-Length, "
-                    "Accept-Encoding, X-CSRF-Token, Authorization")
+                    "Accept-Encoding, X-CSRF-Token, Authorization, "
+                    "X-Babble-Client")
+                for k, v in (extra or {}).items():
+                    self.send_header(k, str(v))
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _json(self, code, obj):
+            def _json(self, code, obj, extra=None):
                 self._send(code, json.dumps(obj).encode(),
-                           "application/json")
+                           "application/json", extra=extra)
 
             def _not_found(self):
                 # A JSON body, not an empty 404: scrapers and probes
@@ -312,46 +325,344 @@ class Service:
                         self._json(500, {"error": str(exc)})
                     finally:
                         service._profile_lock.release()
+                elif url.path.rstrip("/") == "/subscribe":
+                    self._handle_subscribe(url)
+                elif url.path.rstrip("/") == "/debug/ingress":
+                    # Admission-plane table (docs/ingress.md):
+                    # admitted/shed/quota counters, the CoDel
+                    # controller's live state and delay estimate, the
+                    # intake queue snapshot, and the most-recently-
+                    # seen clients' token buckets.
+                    ingress = getattr(service.node, "ingress", None)
+                    if ingress is None:
+                        self._json(200, {"admission": False})
+                    else:
+                        out = {"admission": True}
+                        out.update(ingress.debug_table())
+                        self._json(200, out)
                 else:
                     self._not_found()
 
-            def do_POST(self):  # noqa: N802 - stdlib API
-                url = urlparse(self.path)
-                if url.path.rstrip("/") == "/submit":
-                    # Transaction intake without a socket app client:
-                    # the body is one raw transaction. Used by the
-                    # crash harness (whose nodes run --journal) and
-                    # handy for curl-driven demos; like /debug/*, bind
-                    # service_addr to localhost in production.
+            def _handle_subscribe(self, url):
+                # Commit-subscription stream (docs/ingress.md):
+                # ?tx=<sha256 hex of the raw tx bytes — the digest
+                # /submit* returns>. Long-poll by default (200 with
+                # the commit record, 204 on timeout); SSE with
+                # Accept: text/event-stream or ?sse=1 (heartbeat
+                # comments while waiting, one `commit` event, close).
+                ingress = getattr(service.node, "ingress", None)
+                if ingress is None:
+                    self._json(503, {"error": "admission plane disabled "
+                                     "(--no_admission)"})
+                    return
+                q = parse_qs(url.query)
+                digest = q.get("tx", [""])[0].strip().lower()
+                if len(digest) != 64 or any(
+                        c not in "0123456789abcdef" for c in digest):
+                    self._json(400, {"error": "tx must be the 64-char "
+                                     "sha256 hex digest of the raw "
+                                     "transaction bytes"})
+                    return
+                try:
+                    timeout = float(q.get("timeout", ["30"])[0])
+                except ValueError:
+                    self._json(400, {"error": "bad timeout"})
+                    return
+                timeout = min(max(timeout, 0.0), 120.0)
+                sse = (q.get("sse", ["0"])[0] not in ("0", "")
+                       or "text/event-stream"
+                       in (self.headers.get("Accept") or ""))
+                try:
+                    waiter = ingress.lookup_or_register(digest)
+                except Exception as exc:  # noqa: BLE001
+                    self._json(500, {"error": str(exc)})
+                    return
+                if waiter is None:
+                    # Registry full: shed, never park an unbounded
+                    # number of handler threads.
+                    ingress.shed_subscriber()
+                    self._json(429, {"error": "subscriber registry "
+                                     "full", "retry_after": 1},
+                               extra={"Retry-After": 1})
+                    return
+                if not sse:
                     try:
-                        length = int(self.headers.get("Content-Length", 0))
-                        if length <= 0:
-                            self._json(400, {"error": "empty transaction"})
+                        if waiter.event.wait(timeout):
+                            self._json(200, dict(waiter.result,
+                                                 tx=digest))
+                        else:
+                            self._send(204, b"", "application/json")
+                    finally:
+                        ingress.subscriptions.unregister(digest, waiter)
+                    return
+                # SSE: headers first, heartbeat comments while
+                # waiting, one `commit` (or `timeout`) event, close.
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.end_headers()
+                deadline = time.monotonic() + timeout
+                try:
+                    while True:
+                        left = deadline - time.monotonic()
+                        if waiter.event.wait(min(max(left, 0.0), 5.0)):
+                            payload = json.dumps(
+                                dict(waiter.result, tx=digest))
+                            self.wfile.write(
+                                f"event: commit\ndata: {payload}\n\n"
+                                .encode())
+                            self.wfile.flush()
                             return
-                        if length > _MAX_SUBMIT_BYTES:
-                            # Drain and discard in bounded chunks:
-                            # responding with the body unread breaks
-                            # the client's pipe mid-send, and memory
-                            # must stay capped either way.
-                            remaining = length
-                            while remaining > 0:
-                                chunk = self.rfile.read(
-                                    min(remaining, 65536))
-                                if not chunk:
-                                    break
-                                remaining -= len(chunk)
-                            self._json(413, {"error": "transaction too "
-                                             f"large (max {_MAX_SUBMIT_BYTES}"
-                                             " bytes)"})
+                        if left <= 0:
+                            self.wfile.write(
+                                b"event: timeout\ndata: {}\n\n")
+                            self.wfile.flush()
                             return
-                        tx = self.rfile.read(length)
-                        if not tx:
-                            self._json(400, {"error": "empty transaction"})
-                            return
+                        self.wfile.write(b": ping\n\n")
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client went away mid-stream
+                finally:
+                    self.close_connection = True
+                    ingress.subscriptions.unregister(digest, waiter)
+
+            # -- intake plumbing (docs/ingress.md) -------------------
+
+            def _client_id(self):
+                # Per-client quota key: explicit client id header,
+                # falling back to the remote address.
+                cid = (self.headers.get("X-Babble-Client") or "").strip()
+                return cid or self.client_address[0]
+
+            def _auth_ok(self, cap):
+                """Bearer-token gate for /submit* (Config.submit_token;
+                constant-time compare). Drains the body (bounded)
+                before a 401 so the client never dies on a broken
+                pipe mid-send."""
+                token = getattr(service.node.conf, "submit_token", "")
+                if not token:
+                    return True
+                header = (self.headers.get("Authorization") or "").strip()
+                if hmac.compare_digest(header, "Bearer " + token):
+                    return True
+                self._drain_body(cap)
+                self._json(401, {"error": "unauthorized"},
+                           extra={"WWW-Authenticate": "Bearer"})
+                return False
+
+            def _drain_body(self, cap):
+                """Discard up to ~cap bytes of request body in bounded
+                chunks (the PR 4-review EPIPE lesson: responding with
+                the body unread breaks the client's pipe mid-send;
+                memory must stay capped either way). Past the bound
+                the connection is closed instead."""
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    length = 0
+                remaining = min(length, cap)
+                while remaining > 0:
+                    chunk = self.rfile.read(min(remaining, 65536))
+                    if not chunk:
+                        break
+                    remaining -= len(chunk)
+                if length > cap:
+                    self.close_connection = True
+
+            def _read_body(self, cap, what="transaction"):
+                """Read the request body with the cap enforced WHILE
+                reading — Content-Length is a claim, not a contract:
+                chunked bodies are decoded with a running cap, and a
+                plain body is read in bounded chunks up to min(length,
+                cap). Returns the bytes, or None after answering the
+                error itself."""
+                te = (self.headers.get("Transfer-Encoding") or "").lower()
+                if "chunked" in te:
+                    return self._read_chunked(cap, what)
+                cl = self.headers.get("Content-Length")
+                if cl is None:
+                    self._json(411, {"error": "length required"})
+                    return None
+                try:
+                    length = int(cl)
+                except ValueError:
+                    self._json(400, {"error": "bad Content-Length"})
+                    return None
+                if length < 0:
+                    self._json(400, {"error": "bad Content-Length"})
+                    return None
+                if length > cap:
+                    self._drain_body(cap)
+                    self._json(413, {"error": f"{what} too large "
+                                     f"(max {cap} bytes)"})
+                    return None
+                chunks = []
+                remaining = length
+                while remaining > 0:
+                    chunk = self.rfile.read(min(remaining, 65536))
+                    if not chunk:
+                        break
+                    chunks.append(chunk)
+                    remaining -= len(chunk)
+                return b"".join(chunks)
+
+            def _read_chunked(self, cap, what):
+                """Decode a chunked body with a running size cap: a
+                client whose chunks sum past the cap gets the 413 at
+                the moment of overflow and the connection closed (the
+                remainder cannot be skipped without unbounded reads)."""
+                total = []
+                size_sum = 0
+                while True:
+                    line = self.rfile.readline(34)
+                    if not line:
+                        self._json(400, {"error": "truncated chunked body"})
+                        self.close_connection = True
+                        return None
+                    try:
+                        size = int(line.strip().split(b";")[0], 16)
+                    except ValueError:
+                        self._json(400, {"error": "bad chunk header"})
+                        self.close_connection = True
+                        return None
+                    if size == 0:
+                        # Consume the trailer section up to the blank
+                        # line terminating the body.
+                        while True:
+                            t = self.rfile.readline(1024)
+                            if not t or t in (b"\r\n", b"\n"):
+                                break
+                        break
+                    size_sum += size
+                    if size_sum > cap:
+                        self.close_connection = True
+                        self._json(413, {"error": f"{what} too large "
+                                         f"(max {cap} bytes)"})
+                        return None
+                    remaining = size
+                    while remaining > 0:
+                        chunk = self.rfile.read(min(remaining, 65536))
+                        if not chunk:
+                            self._json(400, {"error":
+                                             "truncated chunked body"})
+                            self.close_connection = True
+                            return None
+                        total.append(chunk)
+                        remaining -= len(chunk)
+                    self.rfile.readline(8)  # trailing CRLF
+                return b"".join(total)
+
+            def _shed_response(self, res):
+                """429 for a fully-rejected request: Retry-After from
+                the controller's delay estimate (shed) or the token
+                bucket's refill time (quota)."""
+                reason = ("quota" if res["quota_rejected"]
+                          and not res["shed"] else "overload")
+                self._json(429, {
+                    "error": "rejected by admission control",
+                    "reason": reason,
+                    "shed": res["shed"],
+                    "quota_rejected": res["quota_rejected"],
+                    "retry_after": res["retry_after"],
+                }, extra={"Retry-After": res["retry_after"]})
+
+            def _handle_submit(self):
+                # Transaction intake without a socket app client: the
+                # body is one raw transaction. Used by the crash
+                # harness (whose nodes run --journal) and handy for
+                # curl-driven demos; like /debug/*, bind service_addr
+                # to localhost in production.
+                try:
+                    if not self._auth_ok(_MAX_SUBMIT_BYTES):
+                        return
+                    tx = self._read_body(_MAX_SUBMIT_BYTES)
+                    if tx is None:
+                        return
+                    if not tx:
+                        self._json(400, {"error": "empty transaction"})
+                        return
+                    ingress = getattr(service.node, "ingress", None)
+                    if ingress is None:
+                        # --no_admission: today's bare intake path,
+                        # byte-for-byte.
                         service.node.submit_tx(tx)
                         self._json(200, {"submitted": len(tx)})
+                        return
+                    res = ingress.submit(self._client_id(), [tx])
+                    if res["accepted"]:
+                        self._json(200, {"submitted": len(tx),
+                                         "digest": res["digests"][0]})
+                    else:
+                        self._shed_response(res)
+                except Exception as exc:  # noqa: BLE001
+                    self._json(500, {"error": str(exc)})
+
+            def _handle_submit_batch(self):
+                # Batched intake: a length-prefixed binary frame
+                # (ingress.encode_tx_batch, magic BBB1 following the
+                # columnar framing conventions) or a JSON array of
+                # base64 transactions. Per-tx statuses come back
+                # aligned with the request order.
+                try:
+                    if not self._auth_ok(_MAX_BATCH_BYTES):
+                        return
+                    body = self._read_body(_MAX_BATCH_BYTES, what="batch")
+                    if body is None:
+                        return
+                    if not body:
+                        self._json(400, {"error": "empty batch"})
+                        return
+                    try:
+                        if body[:4] == TX_BATCH_MAGIC:
+                            txs = decode_tx_batch(body, _MAX_SUBMIT_BYTES)
+                        else:
+                            doc = json.loads(body)
+                            if isinstance(doc, dict):
+                                doc = doc.get("txs")
+                            if not isinstance(doc, list) or not doc:
+                                raise ValueError(
+                                    "body must be a JSON array of "
+                                    "base64 transactions or a BBB1 "
+                                    "binary frame")
+                            txs = [base64.b64decode(t) for t in doc]
+                            for tx in txs:
+                                if not tx:
+                                    raise ValueError(
+                                        "empty transaction in batch")
+                                if len(tx) > _MAX_SUBMIT_BYTES:
+                                    raise ValueError(
+                                        "transaction exceeds "
+                                        f"{_MAX_SUBMIT_BYTES} bytes")
                     except Exception as exc:  # noqa: BLE001
-                        self._json(500, {"error": str(exc)})
+                        self._json(400, {"error": f"bad batch: {exc}"})
+                        return
+                    res = service.node.submit_batch(
+                        txs, client=self._client_id())
+                    if res["accepted"] == 0 and len(txs) > 0 \
+                            and getattr(service.node, "ingress", None) \
+                            is not None:
+                        self._shed_response(res)
+                        return
+                    extra = ({"Retry-After": res["retry_after"]}
+                             if res["retry_after"] else None)
+                    self._json(200, {
+                        "submitted": res["accepted"],
+                        "shed": res["shed"],
+                        "quota_rejected": res["quota_rejected"],
+                        "digests": res["digests"],
+                        "statuses": res["statuses"],
+                        "retry_after": res["retry_after"],
+                    }, extra=extra)
+                except Exception as exc:  # noqa: BLE001
+                    self._json(500, {"error": str(exc)})
+
+            def do_POST(self):  # noqa: N802 - stdlib API
+                path = urlparse(self.path).path.rstrip("/")
+                if path == "/submit":
+                    self._handle_submit()
+                elif path == "/submit/batch":
+                    self._handle_submit_batch()
                 else:
                     self._not_found()
 

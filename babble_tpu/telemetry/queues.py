@@ -108,6 +108,16 @@ class InstrumentedQueue(queue.Queue):
             inst.observe_wait(time.monotonic() - ts)
         return item
 
+    def oldest_age(self) -> float:
+        """Age of the oldest queued entry (0.0 when empty) — the
+        sojourn-time signal the ingress admission controller runs its
+        CoDel law on: unlike depth, it reads as seconds of standing
+        delay regardless of capacity."""
+        with self.mutex:
+            if not self.queue:
+                return 0.0
+            return time.monotonic() - self.queue[0][0]
+
     def put_drop(self, item) -> bool:
         """`put_nowait` that records an overflow drop instead of
         raising — the shed idiom for fire-and-forget producers."""

@@ -372,6 +372,13 @@ def _audit_metrics_scrape(node, phases, file_store=False):
             "babble_verify_backend",
             "babble_verify_batch_size",
             "babble_verify_events_total",
+            # Ingress armor (docs/ingress.md): admission counters
+            # exist (at zero) from boot, and the intake queue reports
+            # through the standard queue families.
+            "babble_ingress_admitted_total",
+            "babble_ingress_shed_total",
+            "babble_ingress_quota_rejected_total",
+            'babble_queue_depth{queue="intake"}',
         ]
         if file_store:
             required.append("babble_store_fsync_seconds")
@@ -397,7 +404,8 @@ def build_host_testnet(n_nodes, engine="host", interval=0.0,
                        store_sync="batch", trace_sample=0.0,
                        wire_format="columnar", transport="inmem",
                        health=True, observatory=True, plumtree=True,
-                       profile_hz=0.0):
+                       profile_hz=0.0, admission=True, quota_rate=0.0,
+                       ingress_target=0.2):
     """Construct (but do not start) a localhost testnet of N real
     nodes: signed keys, fully-meshed transports, per-node stores and
     app proxies — the shared builder behind the throughput smoke, the
@@ -472,6 +480,13 @@ def build_host_testnet(n_nodes, engine="host", interval=0.0,
         # "Saturation"): 0 keeps the sampler thread unspawned — the
         # --profile-overhead A/B drives this.
         conf.profile_hz = profile_hz
+        # Ingress armor (docs/ingress.md): the admission plane is the
+        # product default; admission=False is the bare-intake baseline
+        # leg of the --ingress-overhead A/B. quota_rate exercises the
+        # per-client token buckets (the --loadgen leg drives this).
+        conf.admission = admission
+        conf.quota_rate = quota_rate
+        conf.ingress_target_delay = ingress_target
         if store == "file":
             # Durable-path A/B (docs/robustness.md "Crash recovery"):
             # same testnet over WAL-backed FileStores, so the
@@ -1194,6 +1209,384 @@ def verify_bench(sizes=(1, 8, 64, 512), device_budget_s=150.0):
 
     _emit(payload)
     return 0
+
+
+def _http_testnet(n_nodes, admission, quota_rate=0.0,
+                  ingress_target=0.2, heartbeat=0.0015, interval=0.0):
+    """A host testnet with a Service per node — the real HTTP intake
+    path (docs/ingress.md). Returns (nodes, services); callers own
+    run_async/shutdown/close."""
+    from babble_tpu.service import Service
+
+    nodes = build_host_testnet(
+        n_nodes, engine="host", interval=interval, heartbeat=heartbeat,
+        admission=admission, quota_rate=quota_rate,
+        ingress_target=ingress_target)
+    services = [Service("127.0.0.1:0", nd) for nd in nodes]
+    for svc in services:
+        svc.serve_async()
+    return nodes, services
+
+
+def _ingress_eps(admission, rate=400, batch=40, warm_s=6.0,
+                 window_s=8.0):
+    """Committed ev/s of a 3-node host testnet driven through the
+    real HTTP batch-submit path at a fixed sub-capacity open-loop
+    rate — the measured leg of the --ingress-overhead A/B. Admission
+    ON routes tx intake through quota -> CoDel -> intake queue;
+    OFF is the bare pre-ingress path (submit_ch direct)."""
+    import threading
+    import urllib.request
+
+    from babble_tpu.service.ingress import encode_tx_batch
+
+    nodes, services = _http_testnet(3, admission)
+    stop = threading.Event()
+    seq = [0]
+
+    def bombard():
+        i = 0
+        period = batch / rate
+        nxt = time.monotonic()
+        while not stop.is_set():
+            txs = []
+            for _ in range(batch):
+                txs.append(b"ingress tx %d" % seq[0])
+                seq[0] += 1
+            req = urllib.request.Request(
+                f"http://{services[i % 3].addr}/submit/batch",
+                data=encode_tx_batch(txs), method="POST")
+            try:
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception:  # noqa: BLE001
+                pass
+            i += 1
+            nxt += period
+            delay = nxt - time.monotonic()
+            if delay > 0:
+                stop.wait(delay)
+            else:
+                # Fixed offered rate for the A/B: don't accumulate
+                # scheduling debt into a burst.
+                nxt = time.monotonic()
+
+    committed = lambda: min(  # noqa: E731
+        len(nd.core.get_consensus_events()) for nd in nodes)
+    import sys as _sys
+    old_switch = _sys.getswitchinterval()
+    _sys.setswitchinterval(0.1)
+    try:
+        for nd in nodes:
+            nd.run_async(gossip=True)
+        threading.Thread(target=bombard, daemon=True).start()
+        deadline = time.monotonic() + warm_s
+        while time.monotonic() < deadline and committed() < 150:
+            time.sleep(0.25)
+        c0, t0 = committed(), time.monotonic()
+        time.sleep(window_s)
+        c1, t1 = committed(), time.monotonic()
+        return (c1 - c0) / (t1 - t0)
+    finally:
+        _sys.setswitchinterval(old_switch)
+        stop.set()
+        for svc in services:
+            svc.close()
+        for nd in nodes:
+            nd.shutdown()
+
+
+def ingress_overhead(reps=4, bar=0.05):
+    """Interleaved A/B of the ingress admission plane (same protocol
+    as trace/health/gossip_overhead): `reps` back-to-back pairs of a
+    3-node host testnet bombarded through the REAL HTTP batch-submit
+    path at a fixed sub-capacity rate, one leg with the admission
+    plane ON (per-client quota lookup, CoDel controller, bounded
+    intake queue + coalesced pool inserts — the product default) and
+    one with --no_admission (bare submit_ch intake). Under
+    non-overload load the armor must be free: medians within `bar`
+    (5%) or the exit code fails the CI job."""
+    on_rates, off_rates = [], []
+    payload = {
+        "metric": "ingress_overhead_ab",
+        "nodes": 3,
+        "engine": "host",
+        "reps": reps,
+        "offered_tx_per_s": 400,
+    }
+    try:
+        for rep in range(reps):
+            for label, admission, acc in (("off", False, off_rates),
+                                          ("on", True, on_rates)):
+                eps = _ingress_eps(admission)
+                acc.append(eps)
+                log(f"  rep {rep} admission {label}: {eps:,.1f} ev/s")
+    except Exception as exc:  # noqa: BLE001
+        payload["error"] = str(exc)
+        _emit(payload)
+        return 1
+    off_rates.sort()
+    on_rates.sort()
+    med = lambda xs: (xs[len(xs) // 2] if len(xs) % 2  # noqa: E731
+                      else (xs[len(xs) // 2 - 1] + xs[len(xs) // 2]) / 2)
+    off_med, on_med = med(off_rates), med(on_rates)
+    overhead = 1.0 - on_med / off_med if off_med > 0 else 0.0
+    payload["off_events_per_s"] = [round(x, 1) for x in off_rates]
+    payload["on_events_per_s"] = [round(x, 1) for x in on_rates]
+    payload["off_median"] = round(off_med, 1)
+    payload["on_median"] = round(on_med, 1)
+    payload["overhead_pct"] = round(overhead * 100.0, 2)
+    payload["bar_pct"] = bar * 100.0
+    payload["within_bar"] = overhead <= bar
+    _emit(payload)
+    if overhead > bar:
+        log(f"ingress overhead {overhead:.1%} exceeds the {bar:.0%} bar")
+        return 1
+    return 0
+
+
+def loadgen():
+    """Load-generator mode (docs/ingress.md): drive >= 100k open
+    client transactions (open-loop arrival — each client schedules
+    sends by wall clock, never by response) from many quota'd clients
+    through the real HTTP batch-submit path against a host testnet,
+    then assert the overload contract straight from /metrics:
+
+    - `babble_ingress_shed_total` > 0 and quota rejections > 0 (the
+      offered rate is sized >= 2x the cluster's commit capacity, and
+      a slice of clients is greedy past its bucket),
+    - `babble_queue_dropped_total{queue="commit"}` == 0 — shedding
+      happens at the FRONT door, the commit path never drops,
+    - every ADMITTED transaction commits, byte-identically ordered
+      across nodes,
+    - the admitted-tx p99 commit latency (scraped, cross-node-merged
+      histogram) meets the SLO.
+
+    Emits one JSON payload (loadgen_* keys) gated by bench_compare
+    against the committed LOADGEN_SMOKE.json. Env knobs:
+    LOADGEN_NODES/TXS/RATE/CLIENTS/BATCH/SLO_MS/QUOTA."""
+    import threading
+    import urllib.request
+    from urllib.error import HTTPError
+
+    from babble_tpu.service.ingress import encode_tx_batch
+    from babble_tpu.telemetry import promtext
+
+    n_nodes = int(os.environ.get("LOADGEN_NODES", "3"))
+    total_txs = int(os.environ.get("LOADGEN_TXS", "100000"))
+    rate = float(os.environ.get("LOADGEN_RATE", "2500"))
+    n_clients = int(os.environ.get("LOADGEN_CLIENTS", "24"))
+    batch = int(os.environ.get("LOADGEN_BATCH", "100"))
+    slo_ms = float(os.environ.get("LOADGEN_SLO_MS", "10000"))
+    fair = rate / n_clients
+    # Per-client quota at 2x fair share: in-contract clients never see
+    # the bucket; every 6th client offers 4x fair share and MUST get
+    # quota-rejected — the quota plane exercised, not just configured.
+    quota_rate = float(os.environ.get("LOADGEN_QUOTA", str(2.0 * fair)))
+    payload = {
+        "metric": "loadgen",
+        "nodes": n_nodes,
+        "engine": "host",
+        "loadgen_offered_target": total_txs,
+        "loadgen_rate_tx_per_s": rate,
+        "loadgen_clients": n_clients,
+        "loadgen_quota_tx_per_s": round(quota_rate, 1),
+        "loadgen_slo_ms": slo_ms,
+    }
+    try:
+        calib_eps, _, _ = host_engine_events_per_sec(64, 5000)
+        payload["host_events_per_s"] = round(calib_eps, 1)
+    except Exception as exc:  # noqa: BLE001
+        payload["calibration_error"] = str(exc)
+
+    nodes, services = _http_testnet(
+        n_nodes, admission=True, quota_rate=quota_rate, interval=0.03)
+    lock = threading.Lock()
+    counts = {"offered": 0, "accepted": 0, "shed": 0,
+              "quota_rejected": 0, "http_429": 0, "errors": 0}
+    admitted: set = set()
+    stop = threading.Event()
+
+    def client(idx):
+        greedy = idx % 6 == 0
+        my_rate = fair * (4.0 if greedy else 1.0)
+        period = batch / my_rate
+        svc = services[idx % n_nodes]
+        url = f"http://{svc.addr}/submit/batch"
+        nxt = time.monotonic()
+        i = 0
+        while not stop.is_set():
+            with lock:
+                if counts["offered"] >= total_txs:
+                    return
+                base = counts["offered"]
+                counts["offered"] += batch
+            txs = [b"lg %d %d %d" % (idx, i, base + k)
+                   for k in range(batch)]
+            i += 1
+            req = urllib.request.Request(
+                url, data=encode_tx_batch(txs), method="POST",
+                headers={"X-Babble-Client": f"lg-{idx}"})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    doc = json.loads(r.read())
+            except HTTPError as e:
+                # 429 = the whole batch was rejected; the body still
+                # carries the shed/quota split.
+                try:
+                    doc = json.loads(e.read())
+                except Exception:  # noqa: BLE001
+                    doc = {}
+                with lock:
+                    counts["http_429"] += 1
+                    counts["shed"] += int(doc.get("shed", 0))
+                    counts["quota_rejected"] += int(
+                        doc.get("quota_rejected", batch))
+                doc = None
+            except Exception:  # noqa: BLE001
+                with lock:
+                    counts["errors"] += 1
+                doc = None
+            if doc is not None:
+                with lock:
+                    counts["accepted"] += int(doc.get("submitted", 0))
+                    counts["shed"] += int(doc.get("shed", 0))
+                    counts["quota_rejected"] += int(
+                        doc.get("quota_rejected", 0))
+                    for tx, st in zip(txs, doc.get("statuses", [])):
+                        if st == "accepted":
+                            admitted.add(tx)
+            # Open-loop arrival: the next send is scheduled by wall
+            # clock from the PREVIOUS schedule point, not from when
+            # the response came back.
+            nxt += period
+            delay = nxt - time.monotonic()
+            if delay > 0:
+                stop.wait(delay)
+
+    committed_txs = lambda nd: nd.core.get_consensus_transactions()  # noqa: E731
+    import sys as _sys
+    old_switch = _sys.getswitchinterval()
+    _sys.setswitchinterval(0.1)
+    t0 = time.monotonic()
+    try:
+        for nd in nodes:
+            nd.run_async(gossip=True)
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True)
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        # Progress log while the offered load drains out.
+        while any(t.is_alive() for t in threads):
+            time.sleep(2.0)
+            with lock:
+                snap = dict(counts)
+            log(f"  offered {snap['offered']:,} accepted "
+                f"{snap['accepted']:,} shed {snap['shed']:,} quota "
+                f"{snap['quota_rejected']:,}")
+        offered_wall = time.monotonic() - t0
+        # Drain: every admitted tx must land in every node's committed
+        # stream (the front door shed instead of the commit path
+        # dropping — nothing admitted may be lost).
+        drain_deadline = time.monotonic() + max(
+            120.0, 30.0 * n_nodes)
+        pending = len(nodes)
+        while time.monotonic() < drain_deadline:
+            pending = sum(
+                1 for nd in nodes
+                if not admitted.issubset(set(committed_txs(nd))))
+            if pending == 0:
+                break
+            time.sleep(1.0)
+        wall = time.monotonic() - t0
+        with lock:
+            snap = dict(counts)
+        payload.update({
+            "loadgen_offered": snap["offered"],
+            "loadgen_admitted": snap["accepted"],
+            "loadgen_shed": snap["shed"],
+            "loadgen_quota_rejected": snap["quota_rejected"],
+            "loadgen_http_429": snap["http_429"],
+            "loadgen_errors": snap["errors"],
+            "loadgen_offered_wall_s": round(offered_wall, 1),
+            "loadgen_wall_s": round(wall, 1),
+            "loadgen_admitted_per_s": round(
+                snap["accepted"] / offered_wall, 1),
+            "loadgen_shed_share": round(
+                snap["shed"] / max(snap["offered"], 1), 3),
+        })
+        # The /metrics-side contract: scrape every node's service,
+        # merge the commit-latency histograms, sum the shed/drop
+        # counters — the same bytes a Prometheus server would see.
+        lat_snap = None
+        shed_total = 0.0
+        quota_total = 0.0
+        commit_drops = 0.0
+        for svc in services:
+            with urllib.request.urlopen(
+                    f"http://{svc.addr}/metrics", timeout=10) as r:
+                samples, _ = promtext.parse(r.read().decode())
+            h = promtext.histogram_snapshot(
+                samples, "babble_commit_latency_seconds")
+            lat_snap = h if lat_snap is None else lat_snap.merge(h)
+            shed_total += sum(
+                v for _lb, v in samples.get(
+                    "babble_ingress_shed_total", []))
+            quota_total += sum(
+                v for _lb, v in samples.get(
+                    "babble_ingress_quota_rejected_total", []))
+            commit_drops += sum(
+                v for lb, v in samples.get(
+                    "babble_queue_dropped_total", [])
+                if lb.get("queue") == "commit")
+        p99_ms = round(lat_snap.quantile(0.99) * 1000.0, 1)
+        p50_ms = round(lat_snap.quantile(0.5) * 1000.0, 1)
+        payload["loadgen_commit_latency_p99_ms"] = p99_ms
+        payload["loadgen_commit_latency_p50_ms"] = p50_ms
+        payload["loadgen_scraped_shed_total"] = int(shed_total)
+        payload["loadgen_scraped_quota_rejected"] = int(quota_total)
+        payload["loadgen_commit_drops"] = int(commit_drops)
+        # Byte-identical order across nodes over the common prefix.
+        streams = [committed_txs(nd) for nd in nodes]
+        prefix = min(len(s) for s in streams)
+        order_ok = all(s[:prefix] == streams[0][:prefix]
+                       for s in streams)
+        payload["loadgen_committed_txs"] = prefix
+        failures = []
+        if snap["offered"] < total_txs:
+            failures.append(
+                f"offered {snap['offered']} < target {total_txs}")
+        if shed_total + quota_total <= 0:
+            failures.append("no sheds or quota rejections under a "
+                            ">=2x-capacity firehose")
+        if quota_total <= 0:
+            failures.append("greedy clients never hit their quota")
+        if commit_drops > 0:
+            failures.append(f"commit_ch dropped {int(commit_drops)}")
+        if pending > 0:
+            failures.append(
+                f"{pending} node(s) missing admitted txs after drain")
+        if not order_ok:
+            failures.append("committed tx order diverged across nodes")
+        if p99_ms > slo_ms:
+            failures.append(
+                f"admitted p99 {p99_ms}ms exceeds SLO {slo_ms}ms")
+        payload["loadgen_pass"] = not failures
+        if failures:
+            payload["error"] = "; ".join(failures)
+        _emit(payload)
+        return 1 if failures else 0
+    except Exception as exc:  # noqa: BLE001
+        payload["error"] = str(exc)
+        _emit(payload)
+        return 1
+    finally:
+        _sys.setswitchinterval(old_switch)
+        stop.set()
+        for svc in services:
+            svc.close()
+        for nd in nodes:
+            nd.shutdown()
 
 
 def _soak_coverage_probe(nodes, timeout=15.0):
@@ -2122,6 +2515,10 @@ if __name__ == "__main__":
         sys.exit(profile_overhead())
     elif "--verify-bench" in sys.argv:
         sys.exit(verify_bench())
+    elif "--ingress-overhead" in sys.argv:
+        sys.exit(ingress_overhead())
+    elif "--loadgen" in sys.argv:
+        sys.exit(loadgen())
     elif "--soak" in sys.argv:
         sys.exit(gossip_soak())
     else:

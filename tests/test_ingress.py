@@ -1,0 +1,584 @@
+"""Ingress armor (docs/ingress.md): batched submit, admission control,
+quotas, commit subscriptions, and the overload contract — shed at the
+front door, never drop on the commit path."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from babble_tpu.hashgraph import Block, FileStore, InmemStore
+from babble_tpu.net import InmemTransport
+from babble_tpu.net.faulty_transport import FaultyTransport
+from babble_tpu.net.inmem_transport import connect_all
+from babble_tpu.node import Node
+from babble_tpu.node.config import test_config as fast_config
+from babble_tpu.proxy import InmemAppProxy
+from babble_tpu.proxy.file_app_proxy import FileAppProxy
+from babble_tpu.service import Service
+from babble_tpu.service.ingress import (
+    AdmissionController,
+    ClientQuotas,
+    CommitSubscriptions,
+    TokenBucket,
+    decode_tx_batch,
+    encode_tx_batch,
+    tx_digest,
+)
+from babble_tpu.telemetry import promtext
+
+from test_node import make_keyed_peers
+
+CACHE = 10000
+
+
+# ---------------------------------------------------------------- unit
+
+
+def test_tx_batch_roundtrip():
+    txs = [b"a", b"bb" * 100, b"\x00\xff" * 7]
+    data = encode_tx_batch(txs)
+    assert decode_tx_batch(data, max_tx_bytes=1 << 20) == txs
+
+
+def test_tx_batch_rejects_malformed():
+    txs = [b"one", b"two"]
+    good = encode_tx_batch(txs)
+    for bad, why in [
+        (b"", "too short"),
+        (b"XXXX" + good[4:], "bad magic"),
+        (good[:-1], "truncated payload"),
+        (good + b"x", "trailing bytes"),
+        (encode_tx_batch([b""]), "empty tx"),
+    ]:
+        with pytest.raises(ValueError):
+            decode_tx_batch(bad, max_tx_bytes=1 << 20)
+    with pytest.raises(ValueError):
+        decode_tx_batch(encode_tx_batch([b"x" * 100]), max_tx_bytes=10)
+    with pytest.raises(ValueError):
+        decode_tx_batch(encode_tx_batch([b"x"] * 5), max_tx_bytes=1 << 20,
+                        max_txs=4)
+
+
+def test_token_bucket_refill_and_retry():
+    b = TokenBucket(rate=10.0, burst=5.0, now=100.0)
+    assert b.grant(5, 100.0) == 5
+    assert b.grant(1, 100.0) == 0
+    # refill: 0.2s at 10/s = 2 tokens
+    assert b.grant(5, 100.2) == 2
+    assert b.retry_after() > 0.0
+    # a full burst is restored after burst/rate seconds
+    assert b.grant(5, 200.0) == 5
+
+
+def test_client_quotas_partial_grant_and_eviction():
+    q = ClientQuotas(rate=10.0, burst=4.0, max_clients=3)
+    granted, retry = q.grant("a", 6, now=0.0)
+    assert granted == 4 and retry > 0.0
+    # disabled quotas grant everything
+    q0 = ClientQuotas(rate=0.0)
+    assert not q0.enabled
+    assert q0.grant("anyone", 1000, now=0.0) == (1000, 0.0)
+    # bounded table: a 4th client evicts the least-recently-seen
+    for c in ("b", "c", "d"):
+        q.grant(c, 1, now=1.0)
+    assert len(q._buckets) == 3
+    assert "a" not in q._buckets
+    # auto burst floors at 64
+    assert ClientQuotas(rate=1.0).burst == 64.0
+
+
+def test_admission_controller_codel_law():
+    c = AdmissionController(target=0.1, interval=0.5)
+    # below target: always admit, never arm
+    assert c.admit(0.05, now=0.0)
+    assert not c.state()["shedding"]
+    # above target arms the interval; sheds only after a full one
+    assert c.admit(0.2, now=1.0)
+    assert c.admit(0.2, now=1.4)
+    assert not c.admit(0.2, now=1.6)
+    assert c.state()["shedding"]
+    # while shedding the ramp spaces rejections, admitting between:
+    # the next shed comes a full interval after the first (count=1),
+    # then interval/sqrt(count) after that
+    assert c.admit(0.2, now=1.7)
+    assert not c.admit(0.2, now=2.11)
+    assert not c.admit(0.2, now=2.11 + 0.5 / (2 ** 0.5) + 0.01)
+    # first sample back under target exits and counts the episode
+    assert c.admit(0.05, now=3.0)
+    st = c.state()
+    assert not st["shedding"] and st["episodes"] == 1
+
+
+def test_commit_subscriptions_registry():
+    s = CommitSubscriptions(max_waiters=2, recent_cap=4)
+    w = s.register("d1")
+    assert w is not None and not w.event.is_set()
+    s.resolve("d1", {"round": 7})
+    assert w.event.is_set() and w.result == {"round": 7}
+    assert s.waiter_count() == 0
+    # resolved digests answer from the ring without parking
+    w2 = s.register("d1")
+    assert w2.event.is_set() and w2.result == {"round": 7}
+    # the waiter cap sheds instead of parking unboundedly
+    assert s.register("a") is not None
+    assert s.register("b") is not None
+    assert s.register("c") is None
+    # the ring is bounded
+    for i in range(10):
+        s.resolve(f"r{i}", {"round": i})
+    assert len(s._recent) <= 4
+
+
+def test_file_app_proxy_coalesced_fsync(tmp_path):
+    """sync="batch" (the --journal default) fsyncs once per flush()
+    call — the node calls it per drained commit burst — instead of
+    once per block; sync="always" keeps the per-block policy."""
+    p = FileAppProxy(str(tmp_path / "batch.jsonl"))
+    for r in range(5):
+        p.commit_block(Block(r, [b"tx %d" % r]))
+    assert p.fsync_count == 0
+    p.flush()
+    assert p.fsync_count == 1
+    p.flush()  # clean: no extra fsync
+    assert p.fsync_count == 1
+    assert len(p.committed_transactions()) == 5
+    p.close()
+
+    pa = FileAppProxy(str(tmp_path / "always.jsonl"), sync="always")
+    for r in range(3):
+        pa.commit_block(Block(r, [b"t"]))
+    assert pa.fsync_count == 3
+    pa.close()
+
+
+# ---------------------------------------------------------------- http
+
+
+def make_ingress_nodes(n, heartbeat=0.01, stores=None, faults=None,
+                       **conf_overrides):
+    """An n-node inmem testnet with per-node conf overrides — the
+    ingress plane's knobs live on Config, so tests build their own
+    nodes instead of reusing make_nodes."""
+    inner = [InmemTransport(f"addr{i}", timeout=2.0) for i in range(n)]
+    connect_all(inner)
+    if faults:
+        trans = {t.local_addr(): FaultyTransport(t, seed=11, **faults)
+                 for t in inner}
+    else:
+        trans = {t.local_addr(): t for t in inner}
+    entries = make_keyed_peers(n, addr_fn=lambda i: f"addr{i}")
+    peers = [p for _, p in entries]
+    participants = {p.pub_key_hex: i for i, p in enumerate(peers)}
+    nodes = []
+    for i, (key, peer) in enumerate(entries):
+        conf = fast_config(heartbeat=heartbeat)
+        for k, v in conf_overrides.items():
+            setattr(conf, k, v)
+        store = (stores[i](participants) if stores
+                 else InmemStore(participants, CACHE))
+        node = Node(conf, i, key, peers, store,
+                    trans[peer.net_addr], InmemAppProxy())
+        node.init()
+        nodes.append(node)
+    return nodes
+
+
+def _post(url, data, headers=None, timeout=10):
+    req = urllib.request.Request(url, data=data, method="POST",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read()), r.headers
+
+
+def _wait_committed(nodes, txs, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    want = set(txs)
+    while time.monotonic() < deadline:
+        if all(want <= set(n.core.get_consensus_transactions())
+               for n in nodes):
+            return
+        time.sleep(0.1)
+    missing = [len(want - set(n.core.get_consensus_transactions()))
+               for n in nodes]
+    raise AssertionError(f"txs not committed everywhere: missing {missing}")
+
+
+def test_submit_batch_binary_and_json():
+    """Both /submit/batch forms land txs in consensus, digests line up
+    with sha256(tx), and /subscribe resolves once committed."""
+    nodes = make_ingress_nodes(4)
+    services = [Service("127.0.0.1:0", nd) for nd in nodes]
+    for s in services:
+        s.serve_async()
+    try:
+        for nd in nodes:
+            nd.run_async(gossip=True)
+        bin_txs = [b"bin tx %d" % i for i in range(20)]
+        code, doc, _ = _post(f"http://{services[0].addr}/submit/batch",
+                             encode_tx_batch(bin_txs))
+        assert code == 200
+        assert doc["submitted"] == 20
+        assert doc["statuses"] == ["accepted"] * 20
+        assert doc["digests"] == [tx_digest(t) for t in bin_txs]
+
+        import base64
+        json_txs = [b"json tx %d" % i for i in range(10)]
+        body = json.dumps([base64.b64encode(t).decode()
+                           for t in json_txs]).encode()
+        code, doc, _ = _post(f"http://{services[1].addr}/submit/batch",
+                             body)
+        assert code == 200 and doc["submitted"] == 10
+
+        # single /submit now returns the subscription digest too
+        code, doc, _ = _post(f"http://{services[0].addr}/submit",
+                             b"single tx")
+        assert code == 200
+        assert doc == {"submitted": len(b"single tx"),
+                       "digest": tx_digest(b"single tx")}
+
+        all_txs = bin_txs + json_txs + [b"single tx"]
+        _wait_committed(nodes, all_txs)
+
+        # /subscribe on a committed digest answers immediately from
+        # the recent ring (long-poll form)
+        d = tx_digest(bin_txs[0])
+        with urllib.request.urlopen(
+                f"http://{services[0].addr}/subscribe?tx={d}&timeout=5",
+                timeout=10) as r:
+            assert r.status == 200
+            sub = json.loads(r.read())
+        assert sub["tx"] == d and sub["round"] >= 0
+
+        # SSE form: one `commit` event, then the stream closes
+        req = urllib.request.Request(
+            f"http://{services[0].addr}/subscribe?tx={d}&timeout=5",
+            headers={"Accept": "text/event-stream"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.headers["Content-Type"] == "text/event-stream"
+            stream = r.read().decode()
+        assert "event: commit" in stream
+        assert d in stream
+
+        # unknown digest: 204 on long-poll timeout
+        unknown = "0" * 64
+        req = urllib.request.Request(
+            f"http://{services[0].addr}/subscribe"
+            f"?tx={unknown}&timeout=0.2")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 204
+
+        # malformed digest: 400
+        try:
+            urllib.request.urlopen(
+                f"http://{services[0].addr}/subscribe?tx=nope",
+                timeout=5)
+            raise AssertionError("bad digest accepted")
+        except urllib.error.HTTPError as err:
+            assert err.code == 400
+
+        # malformed batches: 400, not a stack trace
+        for bad in (encode_tx_batch([b"x"])[:-1], b"{}", b"[]"):
+            try:
+                _post(f"http://{services[0].addr}/submit/batch", bad)
+                raise AssertionError("malformed batch accepted")
+            except urllib.error.HTTPError as err:
+                assert err.code == 400
+
+        # /debug/ingress reflects the work
+        with urllib.request.urlopen(
+                f"http://{services[0].addr}/debug/ingress",
+                timeout=5) as r:
+            dbg = json.loads(r.read())
+        assert dbg["admission"] is True
+        assert dbg["admitted"] >= 21
+        assert set(dbg["shed"]) == {"overload", "downstream",
+                                    "intake_full", "subscribers"}
+        assert "controller" in dbg and "intake" in dbg
+    finally:
+        for s in services:
+            s.close()
+        for nd in nodes:
+            nd.shutdown()
+
+
+def test_no_admission_kill_switch():
+    """--no_admission restores the bare intake path byte-for-byte:
+    the old /submit response shape, no ingress object, /subscribe
+    answers 503."""
+    nodes = make_ingress_nodes(4, admission=False)
+    assert all(nd.ingress is None for nd in nodes)
+    svc = Service("127.0.0.1:0", nodes[0])
+    svc.serve_async()
+    try:
+        for nd in nodes:
+            nd.run_async(gossip=True)
+        code, doc, _ = _post(f"http://{svc.addr}/submit", b"legacy tx")
+        assert code == 200
+        assert doc == {"submitted": len(b"legacy tx")}
+        # batch still works, funneled through the bare submit path
+        code, doc, _ = _post(f"http://{svc.addr}/submit/batch",
+                             encode_tx_batch([b"l1", b"l2"]))
+        assert code == 200 and doc["submitted"] == 2
+        try:
+            urllib.request.urlopen(
+                f"http://{svc.addr}/subscribe?tx={'0' * 64}", timeout=5)
+            raise AssertionError("/subscribe with admission off")
+        except urllib.error.HTTPError as err:
+            assert err.code == 503
+        with urllib.request.urlopen(f"http://{svc.addr}/debug/ingress",
+                                    timeout=5) as r:
+            assert json.loads(r.read()) == {"admission": False}
+        _wait_committed(nodes, [b"legacy tx", b"l1", b"l2"])
+    finally:
+        svc.close()
+        for nd in nodes:
+            nd.shutdown()
+
+
+def test_submit_token_auth():
+    nodes = make_ingress_nodes(2, submit_token="sekrit")
+    svc = Service("127.0.0.1:0", nodes[0])
+    svc.serve_async()
+    try:
+        for url in (f"http://{svc.addr}/submit",
+                    f"http://{svc.addr}/submit/batch"):
+            try:
+                _post(url, b"tx")
+                raise AssertionError("unauthenticated submit accepted")
+            except urllib.error.HTTPError as err:
+                assert err.code == 401
+                assert err.headers["WWW-Authenticate"] == "Bearer"
+        # wrong token: still 401
+        try:
+            _post(f"http://{svc.addr}/submit", b"tx",
+                  headers={"Authorization": "Bearer nope"})
+            raise AssertionError("wrong token accepted")
+        except urllib.error.HTTPError as err:
+            assert err.code == 401
+        code, doc, _ = _post(
+            f"http://{svc.addr}/submit", b"authed tx",
+            headers={"Authorization": "Bearer sekrit"})
+        assert code == 200 and doc["digest"] == tx_digest(b"authed tx")
+    finally:
+        svc.close()
+        for nd in nodes:
+            nd.shutdown()
+
+
+def test_quota_429_with_retry_after():
+    nodes = make_ingress_nodes(2, quota_rate=5.0, quota_burst=10.0)
+    svc = Service("127.0.0.1:0", nodes[0])
+    svc.serve_async()
+    try:
+        hdrs = {"X-Babble-Client": "greedy"}
+        # first batch: the 10-token burst grants 10 of 15
+        code, doc, _ = _post(f"http://{svc.addr}/submit/batch",
+                             encode_tx_batch(
+                                 [b"q%d" % i for i in range(15)]),
+                             headers=hdrs)
+        assert code == 200
+        assert doc["submitted"] == 10 and doc["quota_rejected"] == 5
+        assert doc["statuses"][:10] == ["accepted"] * 10
+        assert doc["statuses"][10:] == ["quota_rejected"] * 5
+        assert doc["retry_after"] >= 1
+        # bucket empty: the whole batch rejects -> 429 + Retry-After
+        try:
+            _post(f"http://{svc.addr}/submit/batch",
+                  encode_tx_batch([b"q-again%d" % i for i in range(5)]),
+                  headers=hdrs)
+            raise AssertionError("over-quota batch accepted")
+        except urllib.error.HTTPError as err:
+            assert err.code == 429
+            assert int(err.headers["Retry-After"]) >= 1
+            body = json.loads(err.read())
+            assert body["reason"] == "quota"
+            assert body["quota_rejected"] == 5
+        # a different client has its own bucket
+        code, doc, _ = _post(f"http://{svc.addr}/submit", b"other tx",
+                             headers={"X-Babble-Client": "polite"})
+        assert code == 200
+        # the per-client table shows up in /debug/ingress
+        with urllib.request.urlopen(f"http://{svc.addr}/debug/ingress",
+                                    timeout=5) as r:
+            dbg = json.loads(r.read())
+        clients = {row["client"]: row for row in dbg["quota"]["clients"]}
+        assert clients["greedy"]["rejected"] >= 10
+        assert "polite" in clients
+    finally:
+        svc.close()
+        for nd in nodes:
+            nd.shutdown()
+
+
+def test_chunked_body_cap_enforced():
+    """The 1 MiB /submit cap holds for chunked bodies too — the 413
+    arrives at the moment the decoded size overflows, not after an
+    unbounded buffer."""
+    nodes = make_ingress_nodes(2)
+    svc = Service("127.0.0.1:0", nodes[0])
+    svc.serve_async()
+    try:
+        host, port = svc.addr.split(":")
+        # small chunked body: accepted
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        conn.putrequest("POST", "/submit")
+        conn.putheader("Transfer-Encoding", "chunked")
+        conn.endheaders()
+        conn.send(b"9\r\nchunk tx!\r\n0\r\n\r\n")
+        resp = conn.getresponse()
+        doc = json.loads(resp.read())
+        assert resp.status == 200
+        assert doc["digest"] == tx_digest(b"chunk tx!")
+        conn.close()
+
+        # oversized chunked body: 413 mid-stream, connection closed
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        conn.putrequest("POST", "/submit")
+        conn.putheader("Transfer-Encoding", "chunked")
+        conn.endheaders()
+        chunk = b"x" * 65536
+        frame = b"10000\r\n" + chunk + b"\r\n"
+        try:
+            for _ in range(20):  # 1.25 MiB > the 1 MiB cap
+                conn.send(frame)
+            conn.send(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # server already answered and closed
+        resp = conn.getresponse()
+        assert resp.status == 413
+        conn.close()
+
+        # absent Content-Length without chunking: 411
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        conn.putrequest("POST", "/submit")
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 411
+        conn.close()
+    finally:
+        svc.close()
+        for nd in nodes:
+            nd.shutdown()
+
+
+# ------------------------------------------------------------- chaos
+
+
+@pytest.mark.slow
+def test_overload_shed_before_commit_drop(tmp_path):
+    """The overload contract end-to-end: firehose a 3-node cluster
+    (FaultyTransport delay making consensus the bottleneck) past
+    capacity. Sheds must show up in babble_ingress_shed_total, the
+    commit queue must drop NOTHING, every admitted tx must commit
+    byte-identically across nodes, and /subscribe must still resolve
+    after a node restarts from its FileStore (bootstrap replay +
+    store scan)."""
+    db0 = str(tmp_path / "node0.db")
+    stores = [
+        (lambda p, path=db0: FileStore(p, CACHE, path)),
+        (lambda p: InmemStore(p, CACHE)),
+        (lambda p: InmemStore(p, CACHE)),
+    ]
+    nodes = make_ingress_nodes(
+        3, stores=stores,
+        faults={"delay_min": 0.01, "delay_max": 0.04},
+        intake_queue=128, ingress_target_delay=0.05,
+        ingress_interval=0.1)
+    services = [Service("127.0.0.1:0", nd) for nd in nodes]
+    for s in services:
+        s.serve_async()
+    admitted = []
+    try:
+        for nd in nodes:
+            nd.run_async(gossip=True)
+        # Firehose: batches far larger than the intake queue, no
+        # pacing — guaranteed to overflow intake and build standing
+        # delay while consensus crawls behind the faulty transport.
+        deadline = time.monotonic() + 6.0
+        i = 0
+        sheds_seen = 0
+        while time.monotonic() < deadline:
+            txs = [b"overload %d %d" % (i, k) for k in range(512)]
+            i += 1
+            try:
+                code, doc, _ = _post(
+                    f"http://{services[i % 3].addr}/submit/batch",
+                    encode_tx_batch(txs), timeout=10)
+            except urllib.error.HTTPError as err:
+                body = json.loads(err.read())
+                assert err.code == 429
+                assert int(err.headers["Retry-After"]) >= 1
+                sheds_seen += body.get("shed", 0)
+                continue
+            sheds_seen += doc["shed"]
+            for tx, st in zip(txs, doc["statuses"]):
+                if st == "accepted":
+                    admitted.append(tx)
+        assert sheds_seen > 0, "firehose never triggered a shed"
+        assert admitted, "firehose admitted nothing"
+
+        # every admitted tx commits on every node
+        _wait_committed(nodes, admitted, timeout=120.0)
+
+        # byte-identical order across nodes over the common prefix
+        streams = [nd.core.get_consensus_transactions() for nd in nodes]
+        m = min(len(s) for s in streams)
+        assert m > 0
+        for s in streams[1:]:
+            assert s[:m] == streams[0][:m]
+
+        # the /metrics contract: sheds accounted, zero commit drops
+        shed_total = 0.0
+        commit_drops = 0.0
+        for svc in services:
+            with urllib.request.urlopen(
+                    f"http://{svc.addr}/metrics", timeout=10) as r:
+                samples, _ = promtext.parse(r.read().decode())
+            shed_total += sum(v for _lb, v in samples.get(
+                "babble_ingress_shed_total", []))
+            commit_drops += sum(
+                v for lb, v in samples.get(
+                    "babble_queue_dropped_total", [])
+                if lb.get("queue") == "commit")
+        assert shed_total > 0
+        assert commit_drops == 0, (
+            f"commit path dropped {commit_drops} under overload")
+
+        probe = admitted[0]
+        digest = tx_digest(probe)
+
+        # restart node 0 from its FileStore: /subscribe must resolve
+        # the pre-restart commit from bootstrap replay / store scan
+        services[0].close()
+        nodes[0].shutdown()
+        entries = make_keyed_peers(3, addr_fn=lambda i: f"addr{i}")
+        key0, peer0 = entries[0]
+        peers = [p for _, p in entries]
+        conf = fast_config(heartbeat=0.01)
+        store = FileStore.load(CACHE, db0)
+        t0 = InmemTransport("addr0-reborn", timeout=2.0)
+        node0 = Node(conf, 0, key0, peers, store, t0, InmemAppProxy())
+        node0.init(bootstrap=True)
+        svc0 = Service("127.0.0.1:0", node0)
+        svc0.serve_async()
+        try:
+            with urllib.request.urlopen(
+                    f"http://{svc0.addr}/subscribe?tx={digest}&timeout=5",
+                    timeout=10) as r:
+                assert r.status == 200
+                sub = json.loads(r.read())
+            assert sub["tx"] == digest and sub["round"] >= 0
+        finally:
+            svc0.close()
+            node0.shutdown()
+    finally:
+        for s in services[1:]:
+            s.close()
+        for nd in nodes[1:]:
+            nd.shutdown()
