@@ -110,6 +110,7 @@ def cmd_run(args) -> int:
             else (0.25 if args.engine == "tpu" else 0.0)),
         pipeline_depth=args.pipeline_depth,
         verify_workers=args.verify_workers,
+        runtime=args.runtime,
         device_verify=args.device_verify,
         engine_prewarm=not args.no_prewarm,
         breaker_threshold=0 if args.no_breaker else args.breaker_threshold,
@@ -341,6 +342,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "ingest (batches are ECDSA-checked outside "
                          "the core lock; -1 = one worker per core, "
                          "capped at 8; 0/1 = inline serial)")
+    rn.add_argument("--runtime", choices=["threads", "procs"],
+                    default="threads",
+                    help="execution runtime for the heavy ingest "
+                         "planes (docs/runtime.md): threads = the "
+                         "in-process pool; procs = spawned worker "
+                         "processes fed over shared memory, so "
+                         "verification and large-frame decode run "
+                         "off-GIL and can use additional cores")
     rn.add_argument("--device_verify", action="store_true",
                     help="verify sync-batch ECDSA signatures on the "
                          "device (ops/p256.py vmapped JAX kernel) "

@@ -359,7 +359,12 @@ class ColumnarEvents:
         return b"".join(parts)
 
     @classmethod
-    def decode(cls, buf: bytes) -> "ColumnarEvents":
+    def decode(cls, buf: bytes, validate: bool = True) -> "ColumnarEvents":
+        """Column views over a wire frame. `validate=False` skips the
+        O(n) integrity sweeps (tx-blob sum, tx-count reconciliation) —
+        ONLY for frames a procs-runtime worker already validated
+        (docs/runtime.md "Decode plane"); the structural length check
+        always runs, since the views below depend on it."""
         if len(buf) < 4 + 17 or buf[:4] != MAGIC:
             raise WireFormatError("bad columnar frame header")
         n, flags, t, blob_len = struct.unpack_from("<IBIQ", buf, 4)
@@ -387,12 +392,13 @@ class ColumnarEvents:
         off += 64 * n
         tx_counts = arr("<i4", n, 4)
         tx_lens = arr("<i4", t, 4)
-        total = int(tx_lens.sum()) if t else 0
-        if total != blob_len or (t and int(tx_lens.min()) < 0):
-            raise WireFormatError("tx blob length mismatch")
-        claimed = int(np.maximum(tx_counts, 0).sum()) if n else 0
-        if claimed != t:
-            raise WireFormatError("tx count / length column mismatch")
+        if validate:
+            total = int(tx_lens.sum()) if t else 0
+            if total != blob_len or (t and int(tx_lens.min()) < 0):
+                raise WireFormatError("tx blob length mismatch")
+            claimed = int(np.maximum(tx_counts, 0).sum()) if n else 0
+            if claimed != t:
+                raise WireFormatError("tx count / length column mismatch")
         tx_blob = buf[off:off + blob_len]
         off += blob_len
         trace = arr("<i8", n, 8) if flags & _FLAG_TRACE else None

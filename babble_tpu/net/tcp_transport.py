@@ -173,6 +173,18 @@ def _pack_sync_response(resp: SyncResponse) -> bytes:
     return struct.pack(">I", len(hb)) + hb + events.encode()
 
 
+def _decode_events(buf) -> ColumnarEvents:
+    """Inbound event-frame decode. When any node in this process has
+    opted into the procs runtime, large frames route through its
+    decode plane — the integrity validation runs on a worker process,
+    off the GIL the gossip threads need (docs/runtime.md "Decode
+    plane"); otherwise (and for small frames, and on any worker
+    failure) this is exactly `ColumnarEvents.decode`. Lazy import:
+    net/ must not import node/ at module load."""
+    from ..node.runtime import decode_columnar
+    return decode_columnar(buf)
+
+
 def _unpack_sync_response(buf: bytes) -> SyncResponse:
     if len(buf) < 4:
         raise TransportError("short columnar sync response")
@@ -187,7 +199,7 @@ def _unpack_sync_response(buf: bytes) -> SyncResponse:
         t_reply=header.get("ClockReply", 0),
         health=header.get("Health"),
     )
-    resp.events = ColumnarEvents.decode(buf[4 + hlen:])
+    resp.events = _decode_events(buf[4 + hlen:])
     return resp
 
 
@@ -209,7 +221,7 @@ def _unpack_eager_request(buf: bytes) -> EagerSyncRequest:
     header = json.loads(buf[4:4 + hlen])
     return EagerSyncRequest(
         from_id=header["FromID"],
-        events=ColumnarEvents.decode(buf[4 + hlen:]),
+        events=_decode_events(buf[4 + hlen:]),
         plum=bool(header.get("Plum", False)),
     )
 
@@ -251,7 +263,7 @@ def _unpack_graft_response(buf: bytes) -> GraftResponse:
         from_id=header["FromID"],
         sync_limit=header.get("SyncLimit", False),
     )
-    resp.events = ColumnarEvents.decode(buf[4 + hlen:])
+    resp.events = _decode_events(buf[4 + hlen:])
     return resp
 
 

@@ -116,6 +116,17 @@ class Config:
     # < 0 = auto (one worker per core, capped at 8); 0/1 = verify
     # inline on the syncing thread (still outside the lock).
     verify_workers: int = -1
+    # Execution runtime for the heavy ingest planes (docs/runtime.md):
+    # "threads" (default) keeps signature verification on the
+    # process-global thread pool; "procs" moves verification — and the
+    # large-frame columnar decode — to spawned worker PROCESSES fed
+    # over multiprocessing.shared_memory, so the planes run off-GIL
+    # and can use a second core. Verdict/failure-position semantics
+    # are identical between the two (tests/test_runtime.py pins it);
+    # worker telemetry is scraped over a pipe and merged into /metrics
+    # with a process label. Falls back to "threads" silently where
+    # process spawn or /dev/shm is unavailable.
+    runtime: str = "threads"
     # Device-side signature verification (docs/ingest.md "Crypto
     # plane"): route each sync batch's ECDSA checks to the ops/p256.py
     # vmapped JAX kernel instead of the host verify pool, overlapping

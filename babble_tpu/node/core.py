@@ -38,6 +38,7 @@ class Core:
         engine_opts: Optional[Dict] = None,
         verify_workers: int = -1,
         device_verify: bool = False,
+        runtime: str = "threads",
         trace: Optional[SpanRing] = None,
         registry: Optional[Registry] = None,
         compile_cache_dir: str = "",
@@ -124,6 +125,12 @@ class Core:
         # by default — the flag is the kill switch — and ingest falls
         # back to the host path when JAX is absent.
         self.device_verify = bool(device_verify)
+        # Execution runtime for the verify plane (docs/runtime.md):
+        # per-CORE, not process-global, so one test process can run a
+        # mixed threads/procs cluster and pin byte-identical consensus
+        # across the two.
+        from .runtime import resolve_runtime
+        self.runtime = resolve_runtime(runtime)
         self.head = ""
         self.seq = -1
         self.transaction_pool: List[bytes] = []
@@ -489,10 +496,12 @@ class Core:
                 if unlocked is not None:
                     with unlocked():
                         verify_events(to_verify, self.verify_workers,
-                                      self.device_verify)
+                                      self.device_verify,
+                                      runtime=self.runtime)
                 else:
                     verify_events(to_verify, self.verify_workers,
-                                  self.device_verify)
+                                  self.device_verify,
+                                  runtime=self.runtime)
                 # Per-backend sub-split of the verify wall
                 # (docs/observability.md "Crypto plane"): same interval
                 # stamped under `verify_<backend>` so /debug/phases

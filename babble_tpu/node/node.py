@@ -225,6 +225,7 @@ class Node:
             engine_opts=getattr(conf, "engine_opts", None),
             verify_workers=getattr(conf, "verify_workers", -1),
             device_verify=getattr(conf, "device_verify", False),
+            runtime=getattr(conf, "runtime", "threads"),
             trace=self.trace,
             registry=self.registry,
             compile_cache_dir=getattr(conf, "compile_cache_dir", ""),
@@ -1716,6 +1717,14 @@ class Node:
         # not per node); the sampler throttles itself so several nodes
         # refreshing at one scrape pay once.
         _threadcpu.sample(get_registry())
+        # Procs-runtime workers (docs/runtime.md "Cross-process
+        # scrape"): each worker process keeps its own registry; the
+        # scrape pulls a plain-data snapshot over the worker's pipe and
+        # mirrors it here with a process label, so the saturation plane
+        # still names the bottleneck when the bottleneck is a child.
+        # No-op (and free) while no process pool exists.
+        from .runtime import scrape_children
+        scrape_children(get_registry())
 
     def saturation_stats(self) -> Dict[str, dict]:
         """Per-queue depth/capacity/wait snapshots for the /debug

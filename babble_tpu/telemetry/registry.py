@@ -378,6 +378,53 @@ def render_merged(*registries: Registry) -> str:
     return "\n".join(out) + "\n" if out else ""
 
 
+def export_state(registry: Registry) -> List[tuple]:
+    """Plain-data snapshot of a registry, picklable across a process
+    boundary: [(name, type, help, label_key, payload)] where payload is
+    a float (counter/gauge — computed gauges are evaluated here, in
+    the owning process) or (buckets, counts, sum, count) for a
+    histogram. The procs runtime's workers answer telemetry scrapes
+    with this (docs/runtime.md "Cross-process scrape")."""
+    out: List[tuple] = []
+    for name, (type_, help_, children) in \
+            registry._snapshot_families().items():
+        for key, child in children:
+            if type_ == "histogram":
+                s = child.snapshot()
+                payload = (s.buckets, s.counts, s.sum, s.count)
+            else:
+                payload = float(child.value)
+            out.append((name, type_, help_, key, payload))
+    return out
+
+
+def absorb_state(dst: Registry, state: List[tuple],
+                 **extra_labels) -> None:
+    """Mirror an `export_state` snapshot into `dst`, adding
+    `extra_labels` (e.g. process="verify-0") to every child so the
+    mirrored series never collide with the destination's own. Mirrors
+    REPLACE: each scrape overwrites the child with the worker's current
+    state, so re-scraping is idempotent — and a restarted worker's
+    series reset to zero, exactly like any real per-process
+    collector's."""
+    for name, type_, help_, key, payload in state:
+        labels = dict(key)
+        labels.update(extra_labels)
+        if type_ == "counter":
+            c = dst.counter(name, help_, **labels)
+            with c._lock:
+                c._value = float(payload)
+        elif type_ == "gauge":
+            dst.gauge(name, help_, **labels).set(float(payload))
+        elif type_ == "histogram":
+            buckets, counts, sum_, count = payload
+            h = dst.histogram(name, help_, buckets=buckets, **labels)
+            with h._lock:
+                h._counts = list(counts)
+                h._sum = float(sum_)
+                h._count = int(count)
+
+
 _REGISTRY = Registry()
 
 

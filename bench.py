@@ -399,13 +399,36 @@ def _audit_metrics_scrape(node, phases, file_store=False):
         svc.close()
 
 
+def _runtime_arg() -> str:
+    """`--runtime threads|procs` / BENCH_RUNTIME: the execution
+    runtime every bench testnet is built with (docs/runtime.md)."""
+    if "--runtime" in sys.argv:
+        try:
+            return sys.argv[sys.argv.index("--runtime") + 1]
+        except IndexError:
+            pass
+    return os.environ.get("BENCH_RUNTIME", "threads")
+
+
+def _cpus_effective():
+    """Cores this process may actually run on (None where the
+    platform has no affinity API). Recorded in every soak ledger
+    entry so a 1-core container's numbers are machine-distinguishable
+    from a real multicore run — bench_compare auto-skips the
+    multicore-only gates on it."""
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return None
+
+
 def build_host_testnet(n_nodes, engine="host", interval=0.0,
                        heartbeat=0.0015, store="inmem",
                        store_sync="batch", trace_sample=0.0,
                        wire_format="columnar", transport="inmem",
                        health=True, observatory=True, plumtree=True,
                        profile_hz=0.0, admission=True, quota_rate=0.0,
-                       ingress_target=0.2):
+                       ingress_target=0.2, runtime=None):
     """Construct (but do not start) a localhost testnet of N real
     nodes: signed keys, fully-meshed transports, per-node stores and
     app proxies — the shared builder behind the throughput smoke, the
@@ -447,11 +470,20 @@ def build_host_testnet(n_nodes, engine="host", interval=0.0,
         connect_all(transports)
     peers = [p for _, p in entries]
     participants = {p.pub_key_hex: i for i, p in enumerate(peers)}
+    rt = runtime or _runtime_arg()
     nodes = []
     for i, (key, peer) in enumerate(entries):
         conf = test_config(heartbeat=heartbeat, cache_size=100000)
         conf.engine = engine
         conf.wire_format = wire_format
+        # Execution runtime (docs/runtime.md): procs moves the verify
+        # plane to worker processes. The pool only engages above the
+        # min batch AND workers > 1 — auto would resolve to 1 on a
+        # 1-core box, so the procs leg pins a real pool size (the
+        # point of the leg is measuring the off-GIL path).
+        conf.runtime = rt
+        if rt == "procs":
+            conf.verify_workers = max(2, min(8, os.cpu_count() or 1))
         # Compile the engine's kernel ladder at construction (first
         # node pays; jit caches are process-global) — this is what
         # retired the old 6000-event warm gate.
@@ -1854,6 +1886,11 @@ def gossip_soak_leg(n, wall_s, scrape_s, ts_file, probes=5):
     leg = {
         "n": n,
         "wall_s": round(wall, 1),
+        # Core budget + runtime stamped on EVERY ledger entry: the
+        # machine-readable honesty note. bench_compare auto-skips
+        # multicore-only gates when either side ran on < 2 cores.
+        "cpus_effective": _cpus_effective(),
+        "runtime": _runtime_arg(),
         "events_per_s": round((c1 - c0) / wall, 1),
         "offered_events": int(offered),
         "new_events": int(new),
@@ -1959,6 +1996,7 @@ def gossip_soak():
         "metric": "gossip_soak_multicore" if cpus_req else "gossip_soak",
         "unit": "events/s",
         "engine": "host",
+        "runtime": _runtime_arg(),
         "wall_s_per_leg": wall_s,
         "timeseries_jsonl": ts_file,
         "legs": {},
@@ -1968,11 +2006,32 @@ def gossip_soak():
         if hasattr(os, "sched_setaffinity"):
             avail = sorted(os.sched_getaffinity(0))
             os.sched_setaffinity(0, set(avail[:cpus_req]))
-            payload["cpus_effective"] = len(os.sched_getaffinity(0))
-        else:
-            payload["cpus_effective"] = None  # no affinity API here
+    # Recorded UNCONDITIONALLY (post-pinning), not just on --cpus
+    # runs: every soak ledger carries its real core budget, so
+    # bench_compare can machine-skip multicore-only gates instead of
+    # relying on a hand-written honest note.
+    payload["cpus_effective"] = _cpus_effective()
+    if cpus_req:
         log(f"soak multicore: requested {cpus_req} cpus, "
             f"effective {payload['cpus_effective']}")
+    # 1->2 core scaling factor (ROADMAP multicore gate): when
+    # SOAK_BASELINE_JSON names a prior soak payload (the 1-core
+    # reference leg), each leg's throughput is expressed as a factor
+    # over the baseline's same-n leg — the `soak{n}_scaling_x`
+    # headline bench_compare gates as a raw factor (no machine
+    # normalization: both runs happened on THIS machine).
+    base_eps: dict = {}
+    bp = os.environ.get("SOAK_BASELINE_JSON")
+    if bp and os.path.exists(bp):
+        try:
+            with open(bp) as f:
+                bj = json.load(f)
+            base_eps = {k: v for k, v in bj.items()
+                        if k.endswith("_events_per_s")
+                        and isinstance(v, (int, float))}
+            payload["scaling_baseline"] = bp
+        except Exception as exc:  # noqa: BLE001
+            log(f"scaling baseline unreadable: {exc}")
     try:
         # The shared machine-speed yardstick (see bench_compare.py).
         calib_eps, _, _ = host_engine_events_per_sec(64, 5000)
@@ -2006,6 +2065,16 @@ def gossip_soak():
         if eager.get("redundancy_ratio") is not None:
             payload[f"soak{n}_eager_redundancy_ratio"] = \
                 eager["redundancy_ratio"]
+        # Crypto-plane multicore gate (ROADMAP "verify share < 0.3"):
+        # verify's share of the sync wall, a multicore-only headline —
+        # bench_compare skips it when cpus_effective < 2.
+        ing = leg.get("ingest_phase_share") or {}
+        if ing.get("verify") is not None:
+            payload[f"soak{n}_verify_share"] = ing["verify"]
+        base = base_eps.get(f"soak{n}_events_per_s")
+        if base:
+            payload[f"soak{n}_scaling_x"] = round(
+                leg["events_per_s"] / base, 2)
         log(f"  n={n}: {leg['events_per_s']:,.1f} ev/s, redundancy "
             f"{leg['redundancy_ratio']}, dup share "
             f"{leg['duplicate_share']}, propagation p99 "

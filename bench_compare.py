@@ -108,6 +108,22 @@ for _n in (3, 8, 16, 32, 64):
     # the bottleneck, not to gate it.
     HEADLINES[f"soak{_n}_queue_wait_p99_ms"] = "latency-info"
     HEADLINES[f"soak{_n}_cpu_utilization_cores"] = "ratio-info"
+    # Multicore-only gates (docs/runtime.md): verify's share of the
+    # sync wall (the ROADMAP "< 0.3" crypto-plane gate) and the 1->2
+    # core throughput scaling factor (vs the SOAK_BASELINE_JSON
+    # reference leg). Meaningless on one core — Python threads OR
+    # processes, one core is one core — so compare() machine-skips
+    # them unless BOTH payloads ran with cpus_effective >= 2,
+    # replacing the hand-written honest-note convention.
+    HEADLINES[f"soak{_n}_verify_share"] = "ratio"
+    HEADLINES[f"soak{_n}_scaling_x"] = "factor"
+
+# Keys only a genuinely multicore run can certify: skipped (never
+# gated, never "ok") when either payload ran on < 2 effective cores
+# or predates cpus_effective recording.
+MULTICORE_ONLY = {k for k in HEADLINES
+                  if k.endswith("_verify_share")
+                  or k.endswith("_scaling_x")}
 
 # Crypto-plane microbenchmark (bench.py --verify-bench, docs/ingest.md
 # "Crypto plane"): per-backend µs/event, lower-better. The HOST batch
@@ -160,11 +176,17 @@ def machine_scale(fresh: dict, baseline: dict) -> Optional[float]:
     return float(f) / float(b)
 
 
+def _multicore(payload: dict) -> bool:
+    c = payload.get("cpus_effective")
+    return isinstance(c, (int, float)) and c >= 2
+
+
 def compare(fresh: dict, baseline: dict, tolerance: float,
             normalize: bool = True, gate: bool = True) -> List[dict]:
     """Per-metric delta rows; rows gain status REGRESSION only when
     `gate` is set (same-shape baselines)."""
     scale = machine_scale(fresh, baseline) if normalize else None
+    both_mc = _multicore(fresh) and _multicore(baseline)
     rows: List[dict] = []
     for key, kind in HEADLINES.items():
         b, f = baseline.get(key), fresh.get(key)
@@ -174,6 +196,7 @@ def compare(fresh: dict, baseline: dict, tolerance: float,
         if b is None or f is None or not isinstance(b, (int, float)) \
                 or not isinstance(f, (int, float)) or b <= 0:
             continue
+        skip_mc = key in MULTICORE_ONLY and not both_mc
         if kind == "throughput":
             expected = b * scale if scale else b
             delta = f / expected - 1.0
@@ -189,6 +212,13 @@ def compare(fresh: dict, baseline: dict, tolerance: float,
             expected = b
             delta = f / expected - 1.0
             bad = f > max(b * (1.0 + tolerance), b + 0.1)
+        elif kind == "factor":
+            # Raw higher-better factor (a core-scaling multiple):
+            # both runs happened on this machine, so no yardstick
+            # normalization — the factor IS the normalized number.
+            expected = b
+            delta = f / expected - 1.0
+            bad = delta < -tolerance
         else:
             expected = b / scale if scale else b
             delta = f / expected - 1.0
@@ -197,6 +227,11 @@ def compare(fresh: dict, baseline: dict, tolerance: float,
         row["delta_pct"] = round(delta * 100.0, 1)
         if scale and key == YARDSTICK:
             row["status"] = "yardstick"
+        elif skip_mc:
+            # A 1-core run cannot certify a multicore gate either way
+            # — not gated, and not "ok" either (machine-enforced
+            # replacement for the hand-written honest note).
+            row["status"] = "skipped (cpus_effective < 2)"
         elif not gate or kind.endswith("-info"):
             row["status"] = "info"
         elif bad:

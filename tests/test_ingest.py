@@ -336,10 +336,11 @@ def test_verify_runs_outside_core_lock(monkeypatch):
     release = threading.Event()
     real_verify = core_mod.verify_events
 
-    def blocking_verify(events, workers, device_verify=False):
+    def blocking_verify(events, workers, device_verify=False,
+                        runtime="threads"):
         started.set()
         assert release.wait(timeout=10.0), "verify window never released"
-        real_verify(events, workers, device_verify)
+        real_verify(events, workers, device_verify, runtime=runtime)
 
     monkeypatch.setattr(core_mod, "verify_events", blocking_verify)
     try:

@@ -136,6 +136,56 @@ def test_verify_pool_cancelled_chunks_keep_wait_accounting(monkeypatch):
     assert verdicts == [True] * 3 + [False] + [True] * 12
 
 
+def test_verify_pool_killed_process_keeps_wait_accounting():
+    """The procs-runtime twin of the cancelled-chunk contract (ISSUE
+    18 satellite): a worker PROCESS killed with a chunk in flight must
+    observe the chunk's queued wait on the same verify_pool
+    instrument, count a drop, and re-verify inline — then the
+    supervisor respawns the worker."""
+    import os as _os
+    import signal as _signal
+
+    from babble_tpu import crypto
+    from babble_tpu.hashgraph.event import Event
+    from babble_tpu.node import ingest, runtime as rt
+
+    if not hasattr(_os, "sched_getaffinity"):
+        pytest.skip("procs runtime targets Linux schedulers")
+    rt.reset_for_tests()
+    try:
+        key = crypto.key_from_seed(654)
+        pub = crypto.pub_key_bytes(key)
+        events = []
+        for i in range(16):
+            ev = Event.new([b"kill-%d" % i], ["p0", "p1"], pub, i)
+            ev.sign(key)
+            ev._sig_ok = None
+            events.append(ev)
+        events[3].r = int(events[3].r) ^ 1
+
+        pool = rt.get_pool(2)
+        workers = pool.workers()
+        _os.kill(workers[0].proc.pid, _signal.SIGKILL)
+        workers[0].proc.join(timeout=5.0)
+        # Pin the dead worker in place for this dispatch (the
+        # supervisor would otherwise respawn it BEFORE the send, and
+        # the chunk would never be in flight on a corpse).
+        pool._ensure = \
+            lambda i, count_restart=True: pool._workers[i % pool.size]
+
+        inst = ingest._pool_instrument()
+        before = inst.snapshot()
+        ingest.verify_events(events, workers=2, runtime="procs")
+        after = inst.snapshot()
+
+        assert after["dropped"] == before["dropped"] + 1
+        assert after["waits"] >= before["waits"] + 2
+        verdicts = [ev._sig_ok for ev in events]
+        assert verdicts == [True] * 3 + [False] + [True] * 12
+    finally:
+        rt.reset_for_tests()
+
+
 # ------------------------------------------------------- profiler
 
 
