@@ -123,6 +123,7 @@ def cmd_run(args) -> int:
         profile_hz=args.profile_hz,
         divergence_sentinel=not args.no_sentinel,
         gossip_observatory=not args.no_gossip_observatory,
+        capacity=not args.no_capacity,
         stall_timeout=args.stall_timeout / 1000.0,
         wire_format=args.wire_format,
         max_msg_bytes=args.max_msg_bytes << 20,
@@ -279,6 +280,11 @@ def build_parser() -> argparse.ArgumentParser:
                          "creation-stamp wire sidecar, and the "
                          "propagation-latency histogram — "
                          "docs/observability.md 'Gossip efficiency')")
+    rn.add_argument("--no_capacity", action="store_true",
+                    help="disable the capacity observatory "
+                         "(per-subsystem retained-byte gauges, "
+                         "state-growth slopes and /debug/capacity — "
+                         "docs/observability.md 'Capacity')")
     rn.add_argument("--stall_timeout", type=int, default=30000,
                     help="milliseconds without a decided round (while "
                          "payload events are pending) before the stall "

@@ -18,6 +18,13 @@ class LRU:
         self.size = size
         self.on_evict = on_evict
         self._items: OrderedDict = OrderedDict()
+        # Cache-efficiency accounting (docs/observability.md
+        # "Capacity"): plain unguarded ints — GIL-atomic increments,
+        # read at scrape time only, so churn vs growth is attributable
+        # without a lock on the hot path.
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
 
     def add(self, key, value) -> bool:
         """Insert/update; most-recently-used at the end. True if evicted."""
@@ -28,6 +35,7 @@ class LRU:
         self._items[key] = value
         if len(self._items) > self.size:
             old_key, old_val = self._items.popitem(last=False)
+            self.evictions += 1
             if self.on_evict is not None:
                 self.on_evict(old_key, old_val)
             return True
@@ -36,8 +44,10 @@ class LRU:
     def get(self, key):
         """Returns (value, True) and refreshes recency, or (None, False)."""
         if key in self._items:
+            self.hits += 1
             self._items.move_to_end(key)
             return self._items[key], True
+        self.misses += 1
         return None, False
 
     def contains(self, key) -> bool:

@@ -20,6 +20,11 @@ class RollingIndex:
         self.size = size
         self.last_index = -1
         self.items: List[Any] = []
+        # Items aged out by rolls — the participant-window eviction
+        # signal the capacity plane exports (docs/observability.md
+        # "Capacity"): a window that rolls faster than peers pull is
+        # the TooLate churn source.
+        self.evicted = 0
 
     def get_last_window(self) -> Tuple[List[Any], int]:
         return self.items, self.last_index
@@ -56,4 +61,5 @@ class RollingIndex:
         self.last_index = index
 
     def _roll(self) -> None:
+        self.evicted += min(self.size, len(self.items))
         self.items = self.items[self.size:]

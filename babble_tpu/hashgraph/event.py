@@ -32,6 +32,33 @@ from ..gojson import BigInt, GoStruct, Timestamp, ZERO_TIME, decode_byte_slices,
 # `invalidate()` explicitly after touching fields by hand.
 
 
+class MemoStats:
+    """Process-wide hit/miss accounting for the marshal/hash memos
+    (docs/observability.md "Capacity"): one slotted int increment per
+    accessor call — GIL-atomic, no lock, read only at scrape time.
+    Hits are calls served from the memo; misses are the ones that
+    paid the marshal/sha256. Counts fold Event and EventBody together
+    per kind (the ingest path exercises them as one unit)."""
+
+    __slots__ = ("marshal_hits", "marshal_misses",
+                 "hash_hits", "hash_misses")
+
+    def __init__(self):
+        self.marshal_hits = 0
+        self.marshal_misses = 0
+        self.hash_hits = 0
+        self.hash_misses = 0
+
+    def snapshot(self) -> dict:
+        return {"marshal_hits": self.marshal_hits,
+                "marshal_misses": self.marshal_misses,
+                "hash_hits": self.hash_hits,
+                "hash_misses": self.hash_misses}
+
+
+MEMO_STATS = MemoStats()
+
+
 class EventCoordinates:
     """(hash, index) pointer used in the per-participant coordinate
     vectors — reference event.go:56-59."""
@@ -98,13 +125,19 @@ class EventBody(GoStruct):
     def marshal(self) -> bytes:
         b = self._marshal
         if b is None:
+            MEMO_STATS.marshal_misses += 1
             b = self._marshal = (self.marshal_value() + "\n").encode("utf-8")
+        else:
+            MEMO_STATS.marshal_hits += 1
         return b
 
     def hash(self) -> bytes:
         h = self._hash
         if h is None:
+            MEMO_STATS.hash_misses += 1
             h = self._hash = crypto.sha256(self.marshal())
+        else:
+            MEMO_STATS.hash_hits += 1
         return h
 
 
@@ -246,12 +279,18 @@ class Event(GoStruct):
     def marshal(self) -> bytes:
         b = self._marshal
         if b is None:
+            MEMO_STATS.marshal_misses += 1
             b = self._marshal = (self.marshal_value() + "\n").encode("utf-8")
+        else:
+            MEMO_STATS.marshal_hits += 1
         return b
 
     def hash(self) -> bytes:
         if not self._hash:
+            MEMO_STATS.hash_misses += 1
             self._hash = crypto.sha256(self.marshal())
+        else:
+            MEMO_STATS.hash_hits += 1
         return self._hash
 
     def hex(self) -> str:

@@ -412,6 +412,26 @@ class FileStore:
         except OSError:
             return 0
 
+    def db_bytes(self) -> int:
+        """Main database file size (the durable event log + rounds +
+        blocks; the WAL is separate — wal_bytes)."""
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def capacity_stats(self) -> dict:
+        """Capacity plane (docs/observability.md "Capacity"): the hot
+        cache's sizing plus the durable files. The sqlite files are
+        the store's true retained footprint; the inmem components are
+        the heap working set in front of it."""
+        stats = self.inmem.capacity_stats()
+        stats["files"] = {
+            "db": self.db_bytes(),
+            "wal": self.wal_bytes(),
+        }
+        return stats
+
     def durability_stats(self) -> Dict[str, object]:
         """Observability payload for /Stats, /debug/phases and bench:
         the durable anchors, the sync policy, and the commit (WAL

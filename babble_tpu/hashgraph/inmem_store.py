@@ -224,6 +224,84 @@ class InmemStore:
         if rr > self._last_committed_block:
             self._last_committed_block = rr
 
+    def capacity_stats(self) -> dict:
+        """Capacity-plane sizing (docs/observability.md "Capacity"):
+        row counts + retained-byte estimates per component, and the
+        cache hit/miss/eviction counters. Byte estimates sample a
+        bounded number of entries (telemetry/capacity.sampled_bytes)
+        so a 100k-event cache costs O(256) per scrape. Event objects
+        in the per-creator windows are the SAME objects as the event
+        LRU's values, so the windows bill only pointer slots — RSS is
+        the ground truth, the split is attribution."""
+        from ..telemetry.capacity import (
+            DICT_ENTRY_BYTES, event_bytes, sampled_bytes, str_bytes)
+
+        ev_rows = len(self.event_cache)
+        comps = {
+            "store_event_log": {
+                "rows": ev_rows,
+                "bytes": sampled_bytes(
+                    self.event_cache._items.values(), ev_rows,
+                    event_bytes) + ev_rows * DICT_ENTRY_BYTES,
+            },
+            "store_rounds": {
+                "rows": len(self.round_cache),
+                "bytes": sampled_bytes(
+                    self.round_cache._items.values(),
+                    len(self.round_cache),
+                    lambda ri: 200 + 180 * len(
+                        getattr(ri, "events", ()) or ())),
+            },
+            "store_blocks": {
+                "rows": len(self.block_cache),
+                "bytes": sampled_bytes(
+                    self.block_cache._items.values(),
+                    len(self.block_cache),
+                    lambda b: 400 + sum(
+                        len(t) + 60
+                        for t in (getattr(b, "transactions", None)
+                                  or []))),
+            },
+        }
+        # Hash windows: 66-char hex strings per row; object windows
+        # and the consensus ring share objects already billed above,
+        # so they carry pointer-slot costs only.
+        hash_rows = hash_bytes = 0
+        win_evicted = 0
+        for pe in self.participant_events_cache.participant_events.values():
+            hash_rows += len(pe.items)
+            win_evicted += pe.evicted
+        hash_bytes = hash_rows * (str_bytes("0x" + "0" * 64) + 8)
+        obj_rows = 0
+        for win in self._event_obj_windows.values():
+            obj_rows += len(win.items)
+            win_evicted += win.evicted
+        comps["store_participant_windows"] = {
+            "rows": hash_rows + obj_rows,
+            "bytes": hash_bytes + obj_rows * 8,
+        }
+        comps["store_consensus_window"] = {
+            "rows": len(self.consensus_cache.items),
+            "bytes": len(self.consensus_cache.items)
+            * (str_bytes("0x" + "0" * 64) + 8),
+        }
+        if self._fork_evidence:
+            comps["store_fork_evidence"] = {
+                "rows": len(self._fork_evidence),
+                "bytes": len(self._fork_evidence) * 512,
+            }
+        return {
+            "components": comps,
+            "caches": {
+                "store_events": {
+                    "hits": self.event_cache.hits,
+                    "misses": self.event_cache.misses,
+                    "evictions": self.event_cache.evictions,
+                },
+                "participant_windows": {"evictions": win_evicted},
+            },
+        }
+
     def add_fork_evidence(self, record: dict) -> bool:
         from .health import fork_evidence_key
 

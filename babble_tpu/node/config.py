@@ -226,6 +226,15 @@ class Config:
     # it: no counters, no stamps (wire forms byte-identical to the
     # pre-observatory encoding), no propagation histogram samples.
     gossip_observatory: bool = True
+    # -- capacity observatory (docs/observability.md "Capacity") ------
+    # Per-subsystem retained-byte accounting, state-growth slopes and
+    # the /debug/capacity surface. All sizers are scrape-time lazy
+    # (Gauge.set_fn) — nothing runs unless something scrapes — and the
+    # few hot-path carries are plain int increments, measured within
+    # the 5% bar (bench.py --capacity-overhead). False unregisters the
+    # whole family: no babble_mem_bytes / babble_growth_* series, no
+    # growth model, and /debug/capacity answers {"enabled": false}.
+    capacity: bool = True
     # -- saturation observatory (docs/observability.md "Saturation") ---
     # In-process sampling profiler rate (Hz). 0 (default) = fully off:
     # no sampler thread, no ring, a strict no-op on the hot path.

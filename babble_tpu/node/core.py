@@ -989,6 +989,54 @@ class Core:
         # distinguish "pending" from "not a device engine" (None).
         return report if report is not None else {}
 
+    def capacity_stats(self) -> dict:
+        """Capacity plane (docs/observability.md "Capacity"): this
+        core's retained state — the store's sizing, the host engine's
+        memo tables, the transaction pool, and (device engine) the
+        resident HBM carries. Every piece is getattr-guarded: the
+        device wrapper has no memo tables, InmemAppProxy has no
+        journal, and a scrape must never raise."""
+        from ..telemetry.capacity import sampled_bytes
+
+        out: dict = {"components": {}, "caches": {}}
+        store = self.hg.store
+        scs = getattr(store, "capacity_stats", None)
+        if scs is not None:
+            s = scs()
+            out["components"].update(s.get("components", {}))
+            out["caches"].update(s.get("caches", {}))
+            if "files" in s:
+                out["files"] = s["files"]
+        # Host consensus memo tables (hashgraph/graph.py): pure-DAG
+        # memos — keys are hash tuples whose strings are shared with
+        # the events already billed, so each entry carries tuple +
+        # dict-slot overhead.
+        memo_rows = 0
+        for name in ("_ancestor_cache", "_self_ancestor_cache",
+                     "_oldest_self_ancestor_cache",
+                     "_strongly_see_cache", "_parent_round_cache",
+                     "_round_cache", "_witness_cache"):
+            m = getattr(self.hg, name, None)
+            if m is not None:
+                memo_rows += len(m)
+        divided = getattr(self.hg, "_divided", None)
+        if divided is not None:
+            memo_rows += len(divided)
+        if memo_rows:
+            out["components"]["consensus_memos"] = {
+                "rows": memo_rows, "bytes": memo_rows * 200}
+        pool = self.transaction_pool
+        out["components"]["transaction_pool"] = {
+            "rows": len(pool),
+            "bytes": sampled_bytes(pool, len(pool),
+                                   lambda t: len(t) + 60),
+        }
+        engine = getattr(self.hg, "engine", None)
+        dms = getattr(engine, "device_memory_stats", None)
+        if dms is not None:
+            out["engine"] = dms()
+        return out
+
     def engine_backlog(self) -> int:
         """Events appended but not yet folded by a consensus pass —
         0 for the host engine (consensus runs inline with each sync)."""

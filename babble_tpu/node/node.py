@@ -333,6 +333,17 @@ class Node:
 
             self.ingress = Ingress(self, conf)
 
+        # Capacity observatory (docs/observability.md "Capacity"):
+        # windowed state-growth model, fed at scrape time from the
+        # same component sizers /metrics exports. --no_capacity leaves
+        # it None and the refresh skips the whole plane.
+        self._growth = None
+        self._capacity_snapshot: dict = {}
+        if getattr(conf, "capacity", True):
+            from ..telemetry.capacity import GrowthTracker
+
+            self._growth = GrowthTracker()
+
         self.start_time = time.monotonic()
         # Kept only as the shutdown-once guard; the gossip counters it
         # used to protect live in the registry now (one tiny lock per
@@ -1725,6 +1736,222 @@ class Node:
         # No-op (and free) while no process pool exists.
         from .runtime import scrape_children
         scrape_children(get_registry())
+        # Capacity plane (docs/observability.md "Capacity"): retained
+        # bytes per subsystem + the growth model, all computed here at
+        # scrape time — a strict no-op under --no_capacity.
+        self._refresh_capacity_gauges()
+
+    def _refresh_capacity_gauges(self) -> None:
+        """Scrape-time capacity accounting: per-subsystem retained
+        bytes (babble_mem_bytes), store/WAL/journal file sizes,
+        process RSS + GC view, cache efficiency, device HBM carries,
+        and the windowed growth slopes. Everything is sized here, at
+        scrape time, from bounded samples — the hot paths only carry
+        plain int counters. Keeps the assembled snapshot for
+        /debug/capacity so the JSON surface and /metrics can never
+        disagree."""
+        if self._growth is None:
+            return
+        from ..telemetry import capacity as cap
+
+        reg = self.registry
+        nl = self._node_label
+        g = lambda name, help="", **lb: reg.gauge(name, help, node=nl, **lb)  # noqa: E731
+        core = self.core
+        stats = core.capacity_stats()
+        comps: Dict[str, dict] = dict(stats.get("components", {}))
+        caches: Dict[str, dict] = dict(stats.get("caches", {}))
+        # Node-owned planes the core can't see: the span ring, the
+        # sampled tx-trace map, plumtree's push windows and the
+        # ingress tables.
+        comps["trace_ring"] = {"rows": len(self.trace),
+                               "bytes": len(self.trace) * 400}
+        comps["trace_tx_map"] = {"rows": len(self._tx_trace_ids),
+                                 "bytes": len(self._tx_trace_ids) * 150}
+        if self.plumtree is not None:
+            pcs = getattr(self.plumtree, "capacity_stats", None)
+            if pcs is not None:
+                comps.update(pcs().get("components", {}))
+        if self.ingress is not None:
+            ics = getattr(self.ingress, "capacity_stats", None)
+            if ics is not None:
+                comps.update(ics().get("components", {}))
+        for name, c in comps.items():
+            g("babble_mem_bytes",
+              "Estimated retained bytes per subsystem (scrape-time "
+              "sampled sizers)", component=name).set(c.get("bytes", 0))
+        # Durable files: the store db + WAL from the store, the app
+        # journal from the proxy when it keeps one.
+        files: Dict[str, int] = dict(stats.get("files", {}))
+        jb = getattr(self.proxy, "journal_bytes", None)
+        if jb is not None:
+            files["journal"] = jb()
+        for fname, fbytes in files.items():
+            g("babble_store_bytes",
+              "On-disk bytes per durable file", file=fname).set(fbytes)
+        # Process + GC view and the /dev/shm plane are process-scoped:
+        # they live in the process-global registry, unlabelled, so N
+        # nodes in one test process don't export N copies.
+        greg = get_registry()
+        pm = cap.process_memory()
+        greg.gauge("babble_process_rss_bytes",
+                   "Resident set size (/proc/self/status VmRSS)").set(
+            pm.get("rss_bytes", 0))
+        greg.gauge("babble_process_rss_peak_bytes",
+                   "Peak resident set size (VmHWM)").set(
+            pm.get("rss_peak_bytes", 0))
+        gcs = cap.gc_snapshot()
+        greg.gauge("babble_gc_tracked_objects",
+                   "Objects tracked by the cyclic GC (sum of "
+                   "generation counts)").set(sum(gcs["gen_counts"]))
+        greg.gauge("babble_gc_collections",
+                   "Cumulative cyclic-GC collection passes").set(
+            sum(gcs["collections"]))
+        budget = cap.mem_budget_bytes()
+        greg.gauge("babble_mem_budget_bytes",
+                   "Host memory budget (cgroup limit or MemTotal)"
+                   ).set(budget)
+        from . import runtime as _rt
+        shm = _rt.shm_stats()
+        greg.gauge("babble_shm_bytes",
+                   "Shared-memory segment bytes (procs runtime)",
+                   kind="live").set(shm["live_bytes"])
+        greg.gauge("babble_shm_bytes",
+                   "Shared-memory segment bytes (procs runtime)",
+                   kind="peak").set(shm["peak_bytes"])
+        # Cache efficiency: per-node caches from the store snapshot;
+        # process-wide caches (pub-key LRU, the Event marshal/hash
+        # memos) into the global registry once per process.
+        se = caches.get("store_events", {})
+        for kind in ("hits", "misses", "evictions"):
+            g(f"babble_cache_{kind}_total",
+              "Cache efficiency (cumulative, read at scrape)",
+              cache="store_events").set(se.get(kind, 0))
+        pw = caches.get("participant_windows", {})
+        g("babble_cache_evictions_total",
+          "Cache efficiency (cumulative, read at scrape)",
+          cache="participant_windows").set(pw.get("evictions", 0))
+        from ..crypto.keys import pub_key_from_bytes_cached
+        ci = pub_key_from_bytes_cached.cache_info()
+        greg.gauge("babble_cache_hits_total",
+                   "Cache efficiency (cumulative, read at scrape)",
+                   cache="pub_key").set(ci.hits)
+        greg.gauge("babble_cache_misses_total",
+                   "Cache efficiency (cumulative, read at scrape)",
+                   cache="pub_key").set(ci.misses)
+        from ..hashgraph.event import MEMO_STATS
+        ms = MEMO_STATS.snapshot()
+        for memo in ("marshal", "hash"):
+            greg.gauge("babble_cache_hits_total",
+                       "Cache efficiency (cumulative, read at scrape)",
+                       cache=f"event_{memo}").set(ms[f"{memo}_hits"])
+            greg.gauge("babble_cache_misses_total",
+                       "Cache efficiency (cumulative, read at scrape)",
+                       cache=f"event_{memo}").set(ms[f"{memo}_misses"])
+        caches["pub_key"] = {"hits": ci.hits, "misses": ci.misses,
+                             "size": ci.currsize, "max": ci.maxsize}
+        caches["event_marshal"] = {"hits": ms["marshal_hits"],
+                                   "misses": ms["marshal_misses"]}
+        caches["event_hash"] = {"hits": ms["hash_hits"],
+                                "misses": ms["hash_misses"]}
+        # Device memory plane (engine seam, ops/incremental.py): live
+        # HBM carries, the per-kernel cost-report byte columns, and the
+        # headroom projection from the dominant O(n^2 K) chain cube.
+        eng = stats.get("engine")
+        if eng:
+            g("babble_engine_hbm_bytes",
+              "Engine-resident device array bytes",
+              kind="live").set(eng.get("device_bytes", 0))
+            if eng.get("hbm_budget_bytes"):
+                g("babble_engine_hbm_bytes",
+                  "Engine-resident device array bytes",
+                  kind="budget").set(eng["hbm_budget_bytes"])
+            g("babble_engine_host_mirror_bytes",
+              "Host numpy mirrors of engine state").set(
+                eng.get("host_mirror_bytes", 0))
+            if eng.get("projected_max_peers"):
+                g("babble_engine_projected_max_peers",
+                  "Peers fitting the device budget at the current "
+                  "per-peer footprint").set(eng["projected_max_peers"])
+            for kname, kb in (eng.get("kernels") or {}).items():
+                for kind in ("output_bytes", "temp_bytes"):
+                    if kb.get(kind):
+                        g("babble_engine_kernel_bytes",
+                          "Per-kernel XLA memory_analysis bytes",
+                          kernel=kname, kind=kind.split("_")[0]).set(
+                            kb[kind])
+        # Growth model: every component plus the durable files and RSS
+        # observed against committed blocks; slopes exported only once
+        # the window has two distinct points.
+        x = core.hg.store.last_committed_block()
+        for name, c in comps.items():
+            self._growth.observe(name, x, c.get("bytes", 0))
+        for fname, fbytes in files.items():
+            self._growth.observe(fname, x, fbytes)
+        self._growth.observe("rss", x, pm.get("rss_bytes", 0))
+        slopes = {s: sl for s, sl in self._growth.slopes().items()
+                  if sl is not None}
+        for series, slope in slopes.items():
+            g("babble_growth_bytes_per_block",
+              "Windowed least-squares growth slope vs committed "
+              "blocks", series=series).set(slope)
+        # Cardinality self-audit: series-per-family across this node's
+        # registry and the process-global one — the observatory watches
+        # its own footprint too.
+        counts = cap.series_counts(reg, greg)
+        for fam, n in counts.items():
+            g("babble_telemetry_series",
+              "Exported series per metric family (self-audit)",
+              family=fam).set(n)
+        g("babble_telemetry_series_total",
+          "Total exported series across registries").set(
+            sum(counts.values()))
+        self._capacity_snapshot = {
+            "enabled": True,
+            "committed_block": x,
+            "components": comps,
+            "files": files,
+            "caches": caches,
+            "process": pm,
+            "gc": gcs,
+            "shm": shm,
+            "budget_bytes": budget,
+            "engine": eng or {},
+            "series": {"total": sum(counts.values()),
+                       "families": len(counts)},
+        }
+
+    def get_capacity_stats(self) -> dict:
+        """The /debug/capacity surface: the scrape snapshot plus the
+        ranked top-growers table and projected headroom — derived from
+        the same sizers and growth window /metrics exports."""
+        if self._growth is None:
+            return {"enabled": False}
+        self._refresh_telemetry_gauges()
+        out = dict(self._capacity_snapshot)
+        slopes = {s: sl for s, sl in self._growth.slopes().items()
+                  if sl is not None}
+        budget = out.get("budget_bytes", 0)
+        rss = out.get("process", {}).get("rss_bytes", 0)
+        growth = {}
+        for series, slope in sorted(slopes.items(),
+                                    key=lambda kv: -kv[1]):
+            entry = {"slope_bytes_per_block": slope,
+                     "last_bytes": self._growth.last(series)}
+            if series == "rss" and budget:
+                entry["blocks_to_budget"] = self._growth.to_budget(
+                    series, budget)
+            growth[series] = entry
+        out["growth"] = growth
+        # Top growers: steepest positive byte slope first — the table
+        # the retention soak names its verdict from.
+        out["top_growers"] = [
+            {"series": s, "slope_bytes_per_block": sl}
+            for s, sl in sorted(slopes.items(), key=lambda kv: -kv[1])
+            if sl > 0][:10]
+        if budget and rss:
+            out["headroom_bytes"] = max(0, budget - rss)
+        return out
 
     def saturation_stats(self) -> Dict[str, dict]:
         """Per-queue depth/capacity/wait snapshots for the /debug

@@ -271,6 +271,34 @@ class Plumtree:
             "push_backlog": pending,
         }
 
+    def capacity_stats(self) -> dict:
+        """Capacity plane (docs/observability.md "Capacity"): retained
+        bytes of the tree's bounded state — per-peer push buffers
+        (events shared with the store bill pointer+timestamp slots
+        plus a sampled payload estimate), the IHAVE digest ring, and
+        the missing tracker."""
+        from ..telemetry.capacity import event_bytes, sampled_bytes
+
+        with self._lock:
+            buffered = [(ts, ev) for st in self._push.values()
+                        for (ts, ev) in st.buffer]
+            digests = len(self._digests)
+            missing = len(self._missing)
+        push_rows = len(buffered)
+        push_bytes = push_rows * 80 + sampled_bytes(
+            (ev for _ts, ev in buffered), push_rows, event_bytes,
+            sample=64)
+        return {
+            "components": {
+                "plumtree_push_windows": {
+                    "rows": push_rows, "bytes": push_bytes},
+                "plumtree_digests": {
+                    "rows": digests, "bytes": digests * 200},
+                "plumtree_missing": {
+                    "rows": missing, "bytes": missing * 400},
+            },
+        }
+
     # -- saturation accounting ---------------------------------------------
 
     def _window_inst(self, addr: str) -> QueueInstrument:

@@ -78,6 +78,34 @@ _MIN_DECODE_BYTES = 16384
 
 _pool = None
 _pool_lock = threading.Lock()
+
+# Capacity plane (docs/observability.md "Capacity"): live + peak bytes
+# of the verify/decode shared-memory segments. Segments are born and
+# unlinked within one batch, so `live` is usually 0 at scrape — the
+# peak is the number that sizes /dev/shm headroom.
+_shm_lock = threading.Lock()
+_shm_live_bytes = 0
+_shm_peak_bytes = 0
+
+
+def _shm_track(nbytes: int) -> None:
+    global _shm_live_bytes, _shm_peak_bytes
+    with _shm_lock:
+        _shm_live_bytes += nbytes
+        if _shm_live_bytes > _shm_peak_bytes:
+            _shm_peak_bytes = _shm_live_bytes
+
+
+def _shm_untrack(nbytes: int) -> None:
+    global _shm_live_bytes
+    with _shm_lock:
+        _shm_live_bytes = max(0, _shm_live_bytes - nbytes)
+
+
+def shm_stats() -> dict:
+    with _shm_lock:
+        return {"live_bytes": _shm_live_bytes,
+                "peak_bytes": _shm_peak_bytes}
 _last_scrape = 0.0
 _SCRAPE_MIN_INTERVAL = 0.2
 
@@ -512,6 +540,7 @@ def verify_events_procs(events: List, workers: int) -> bool:
             create=True, size=vo + n)
     except Exception:  # noqa: BLE001 - no /dev/shm -> thread fallback
         return False
+    _shm_track(vo + n)
     try:
         buf = shm.buf
         buf[0:4] = VERIFY_MAGIC
@@ -553,6 +582,7 @@ def verify_events_procs(events: List, workers: int) -> bool:
             shm.unlink()
         except FileNotFoundError:
             pass
+        _shm_untrack(vo + n)
     return True
 
 
@@ -584,6 +614,7 @@ def decode_columnar(buf):
         shm = shared_memory.SharedMemory(create=True, size=len(buf))
     except Exception:  # noqa: BLE001
         return ColumnarEvents.decode(buf)
+    _shm_track(len(buf))
     try:
         shm.buf[:len(buf)] = buf
         try:
@@ -599,6 +630,7 @@ def decode_columnar(buf):
             shm.unlink()
         except FileNotFoundError:
             pass
+        _shm_untrack(len(buf))
     return ColumnarEvents.decode(validated, validate=False)
 
 

@@ -1609,6 +1609,75 @@ class IncrementalEngine:
         except Exception as exc:  # noqa: BLE001 - report, don't wedge
             return {"error": str(exc)}
 
+    def device_memory_stats(self) -> dict:
+        """Device-memory plane (docs/observability.md "Capacity"):
+        live HBM bytes of the engine's resident carries (every
+        jax.Array attribute), the host-mirror numpy bytes, the
+        device-reported budget, and a projected-peers headroom
+        estimate for the sharded northstar. Never raises — this runs
+        inside a /metrics scrape."""
+        import numpy as _np
+
+        dev = host = 0
+        try:
+            import jax as _jax
+
+            for v in vars(self).values():
+                if isinstance(v, _jax.Array):
+                    dev += int(getattr(v, "nbytes", 0))
+                elif isinstance(v, _np.ndarray):
+                    host += int(v.nbytes)
+        except Exception:  # noqa: BLE001
+            return {"device_bytes": 0, "host_mirror_bytes": 0}
+        out = {
+            "device_bytes": dev,
+            "host_mirror_bytes": host,
+            "events": self.e,
+            "capacity": self.cap,
+            "chain_capacity": self.kcap,
+            "n": self.n,
+        }
+        try:
+            mem = _jax.devices()[0].memory_stats() or {}
+            budget = int(mem.get("bytes_limit", 0) or 0)
+            if budget:
+                out["hbm_budget_bytes"] = budget
+                out["hbm_in_use_bytes"] = int(
+                    mem.get("bytes_in_use", 0) or 0)
+        except Exception:  # noqa: BLE001 - backend-optional API
+            budget = 0
+        if budget and dev > 0:
+            # Headroom model: the dominant resident term is the
+            # chain_la cube at O(n^2 * K) bytes, so usage scales
+            # ~quadratically in participants at fixed window depth —
+            # the largest n this budget supports at the current
+            # per-peer footprint is n * sqrt(budget / device_bytes).
+            # A mesh multiplies the budget by its device count (the
+            # cube is sharded on the participant axis).
+            devices = 1
+            if self._mesh is not None:
+                try:
+                    devices = int(self._mesh.size)
+                except Exception:  # noqa: BLE001
+                    devices = 1
+            out["projected_max_peers"] = int(
+                self.n * ((budget * devices) / dev) ** 0.5)
+        # Per-kernel compiled memory_analysis, when a cost capture has
+        # run (/debug/profile?cost=1 arms it).
+        report = self.cost_report
+        if isinstance(report, dict):
+            kernels = {}
+            for kernel, d in report.items():
+                if isinstance(d, dict) and (
+                        "output_bytes" in d or "temp_bytes" in d):
+                    kernels[kernel] = {
+                        "output_bytes": d.get("output_bytes", 0.0),
+                        "temp_bytes": d.get("temp_bytes", 0.0),
+                    }
+            if kernels:
+                out["kernels"] = kernels
+        return out
+
     def _collect_pass(self, pp: PendingPass, unlocked) -> RunDelta:
         n = self.n
         import time as _time

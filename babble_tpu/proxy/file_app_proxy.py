@@ -107,6 +107,14 @@ class FileAppProxy:
             except (OSError, ValueError):
                 pass
 
+    def journal_bytes(self) -> int:
+        """Journal file size for the capacity plane
+        (babble_store_bytes{file="journal"})."""
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
     def last_round(self) -> int:
         with self._lock:
             return self._last_round

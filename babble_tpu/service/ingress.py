@@ -362,6 +362,31 @@ class Ingress:
             for reason in SHED_REASONS
         }
 
+    def capacity_stats(self) -> dict:
+        """Capacity plane (docs/observability.md "Capacity"): retained
+        bytes of the admission tables — the per-client token-bucket
+        map, the parked-subscriber registry, and the recent-commit
+        lookup ring. The intake queue itself reports through the
+        standard queue families."""
+        with self.quotas._lock:
+            buckets = len(self.quotas._buckets)
+        subs = self.subscriptions
+        with subs._lock:
+            waiters = subs._count
+            recent = len(subs._recent)
+        return {
+            "components": {
+                "ingress_quota_table": {
+                    "rows": buckets, "bytes": buckets * 260},
+                "ingress_subscriptions": {
+                    "rows": waiters + recent,
+                    # A parked waiter is an Event + dict entry; a
+                    # recent-commit row is a digest -> small-dict map
+                    # entry.
+                    "bytes": waiters * 400 + recent * 360},
+            },
+        }
+
     # -- admission ----------------------------------------------------
 
     def delay(self) -> float:

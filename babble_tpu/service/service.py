@@ -217,6 +217,15 @@ class Service:
                         "round_lag": node.round_lag(),
                         "peers": peers,
                     })
+                elif url.path.rstrip("/") == "/debug/capacity":
+                    # Capacity observatory (docs/observability.md
+                    # "Capacity"): per-subsystem retained bytes,
+                    # durable file sizes, cache efficiency, process
+                    # RSS/GC, device HBM carries, and the windowed
+                    # growth slopes with the ranked top-growers table
+                    # and time-to-budget projection. {"enabled":
+                    # false} under --no_capacity.
+                    self._json(200, service.node.get_capacity_stats())
                 elif url.path.rstrip("/") == "/debug/consensus":
                     # Consensus health plane (docs/observability.md
                     # "Consensus health"): chain state + divergence

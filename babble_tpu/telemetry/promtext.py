@@ -164,6 +164,30 @@ def check_series(samples: Dict[str, List[Sample]],
     return missing
 
 
+def family_series_counts(
+        samples: Dict[str, List[Sample]]) -> Dict[str, int]:
+    """Series per family from a parsed scrape — histogram `_bucket`/
+    `_sum`/`_count` sample names fold back onto their family name, and
+    bucket rows count once per child (the `le` label is stripped), so
+    the number measures label-set cardinality, not bucket resolution."""
+    hist_stems = {n[:-len("_bucket")] for n in samples
+                  if n.endswith("_bucket")}
+    out: Dict[str, int] = {}
+    for name, rows in samples.items():
+        fam = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            stem = name[:-len(suffix)]
+            if name.endswith(suffix) and stem in hist_stems:
+                fam = stem
+                break
+        keys = {tuple(sorted((k, v) for k, v in labels.items()
+                             if k != "le"))
+                for labels, _v in rows}
+        cur = out.get(fam)
+        out[fam] = max(cur, len(keys)) if cur is not None else len(keys)
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
 
@@ -176,6 +200,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "label matchers select specific series, e.g. "
                          "babble_forks_total{creator=\"0x04AB\"} "
                          "(repeatable)")
+    ap.add_argument("--max-series", type=int, default=0, metavar="N",
+                    help="fail when any single family exposes more "
+                         "than N series (label-set cardinality lint; "
+                         "0 = unchecked)")
     args = ap.parse_args(argv)
     text = sys.stdin.read()
     try:
@@ -192,6 +220,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"promtext: missing required series: {missing}",
               file=sys.stderr)
         return 1
+    if args.max_series > 0:
+        fat = {fam: n
+               for fam, n in family_series_counts(samples).items()
+               if n > args.max_series}
+        if fat:
+            worst = sorted(fat.items(), key=lambda kv: -kv[1])
+            print(f"promtext: cardinality over --max-series="
+                  f"{args.max_series}: {worst}", file=sys.stderr)
+            return 1
     print(f"promtext: ok ({len(samples)} sample families, "
           f"{len(types)} typed)")
     return 0

@@ -379,9 +379,21 @@ def _audit_metrics_scrape(node, phases, file_store=False):
             "babble_ingress_shed_total",
             "babble_ingress_quota_rejected_total",
             'babble_queue_depth{queue="intake"}',
+            # Capacity observatory (docs/observability.md "Capacity"):
+            # per-subsystem retained bytes, the process RSS ground
+            # truth, cache efficiency, and the cardinality self-audit
+            # all refresh at scrape.
+            "babble_mem_bytes",
+            'babble_mem_bytes{component="store_event_log"}',
+            "babble_process_rss_bytes",
+            "babble_mem_budget_bytes",
+            'babble_cache_hits_total{cache="store_events"}',
+            "babble_telemetry_series",
+            "babble_telemetry_series_total",
         ]
         if file_store:
             required.append("babble_store_fsync_seconds")
+            required.append('babble_store_bytes{file="wal"}')
         missing = promtext.check_series(samples, required)
         if missing:
             raise RuntimeError(
@@ -428,7 +440,8 @@ def build_host_testnet(n_nodes, engine="host", interval=0.0,
                        wire_format="columnar", transport="inmem",
                        health=True, observatory=True, plumtree=True,
                        profile_hz=0.0, admission=True, quota_rate=0.0,
-                       ingress_target=0.2, runtime=None):
+                       ingress_target=0.2, runtime=None,
+                       capacity=True):
     """Construct (but do not start) a localhost testnet of N real
     nodes: signed keys, fully-meshed transports, per-node stores and
     app proxies — the shared builder behind the throughput smoke, the
@@ -519,6 +532,12 @@ def build_host_testnet(n_nodes, engine="host", interval=0.0,
         conf.admission = admission
         conf.quota_rate = quota_rate
         conf.ingress_target_delay = ingress_target
+        # Capacity observatory (docs/observability.md "Capacity"): the
+        # product default; capacity=False is the baseline leg of the
+        # --capacity-overhead A/B (no sizers, no growth model, hot-path
+        # carry counters still incremented — they are the cheap part
+        # the A/B exists to bound).
+        conf.capacity = capacity
         if store == "file":
             # Durable-path A/B (docs/robustness.md "Crash recovery"):
             # same testnet over WAL-backed FileStores, so the
@@ -544,7 +563,8 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
                                 metrics_scrape=False, trace_sample=0.0,
                                 wire_format="columnar", heartbeat=None,
                                 transport="inmem", health=True,
-                                observatory=True, profile_hz=0.0):
+                                observatory=True, profile_hz=0.0,
+                                capacity=True, scrape_hz=0.0):
     """Throughput of a live localhost testnet: N real nodes (threads,
     inmem transport, signed events, full sync protocol) bombarded with
     transactions; returns (committed consensus events/sec during a
@@ -595,7 +615,8 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
         n_nodes, engine=engine, interval=interval, heartbeat=heartbeat,
         store=store, store_sync=store_sync, trace_sample=trace_sample,
         wire_format=wire_format, transport=transport, health=health,
-        observatory=observatory, profile_hz=profile_hz)
+        observatory=observatory, profile_hz=profile_hz,
+        capacity=capacity)
 
     stop = threading.Event()
     # One process, dozens of pure-Python threads: the default 5 ms GIL
@@ -615,6 +636,17 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
             i += 1
             time.sleep(0.002)
 
+    def scraper():
+        # Simulated Prometheus: refresh every scrape-time gauge at a
+        # fixed cadence so an A/B leg pays what a scraped production
+        # node pays (the capacity sizers only run when scraped).
+        while not stop.is_set():
+            try:
+                nodes[0].get_stats()
+            except Exception:  # noqa: BLE001
+                pass
+            stop.wait(1.0 / scrape_hz)
+
     committed = lambda: min(  # noqa: E731
         len(nd.core.get_consensus_events()) for nd in nodes)
     try:
@@ -622,6 +654,8 @@ def node_testnet_events_per_sec(engine="tpu", n_nodes=4, warm_s=60.0,
             nd.run_async(gossip=True)
         bomber = threading.Thread(target=bombard, daemon=True)
         bomber.start()
+        if scrape_hz > 0:
+            threading.Thread(target=scraper, daemon=True).start()
         # Warmup gate: the tunneled runtime compiles each engine shape
         # per process (~2 min for the live-node presize at small n; the
         # persistent cache does not cover this backend), and the first
@@ -1087,6 +1121,59 @@ def gossip_overhead(reps=4, bar=0.05):
     _emit(payload)
     if overhead > bar:
         log(f"gossip overhead {overhead:.1%} exceeds the {bar:.0%} bar")
+        return 1
+    return 0
+
+
+def capacity_overhead(reps=4, bar=0.05):
+    """Interleaved A/B of the capacity observatory (same protocol as
+    trace/health/gossip_overhead): `reps` back-to-back pairs of the
+    3-node host smoke with the capacity plane ON (the product default
+    — scrape-time sizers, the growth model, the cardinality audit) vs
+    OFF, both legs scraped at 1 Hz so the on leg pays what a
+    Prometheus-watched production node pays. The hot-path carries
+    (cache hit/miss ints) are unconditional in both legs — the A/B
+    bounds the scrape-time plane. Medians must agree within `bar` (5%)
+    or the exit code fails the CI job."""
+    on_rates, off_rates = [], []
+    payload = {
+        "metric": "capacity_overhead_ab",
+        "nodes": 3,
+        "engine": "host",
+        "scrape_hz": 1.0,
+        "reps": reps,
+    }
+    try:
+        for rep in range(reps):
+            for label, cap_on, acc in (("off", False, off_rates),
+                                       ("on", True, on_rates)):
+                eps, _ = node_testnet_events_per_sec(
+                    engine="host", n_nodes=3, warm_s=6.0, window_s=8.0,
+                    interval=0.0, warm_gate_events=150, windows=1,
+                    capacity=cap_on, scrape_hz=1.0)
+                acc.append(eps)
+                log(f"  rep {rep} capacity {label}: {eps:,.1f} ev/s")
+    except Exception as exc:  # noqa: BLE001
+        payload["error"] = str(exc)
+        _emit(payload)
+        return 1
+    off_rates.sort()
+    on_rates.sort()
+    med = lambda xs: (xs[len(xs) // 2] if len(xs) % 2  # noqa: E731
+                      else (xs[len(xs) // 2 - 1] + xs[len(xs) // 2]) / 2)
+    off_med, on_med = med(off_rates), med(on_rates)
+    overhead = 1.0 - on_med / off_med if off_med > 0 else 0.0
+    payload["off_events_per_s"] = [round(x, 1) for x in off_rates]
+    payload["on_events_per_s"] = [round(x, 1) for x in on_rates]
+    payload["off_median"] = round(off_med, 1)
+    payload["on_median"] = round(on_med, 1)
+    payload["overhead_pct"] = round(overhead * 100.0, 2)
+    payload["bar_pct"] = bar * 100.0
+    payload["within_bar"] = overhead <= bar
+    _emit(payload)
+    if overhead > bar:
+        log(f"capacity overhead {overhead:.1%} exceeds the {bar:.0%} "
+            f"bar")
         return 1
     return 0
 
@@ -2088,6 +2175,219 @@ def gossip_soak():
     return 1 if failures else 0
 
 
+# --------------------------------------------------------------------------
+# Retention soak (docs/observability.md "Capacity"): the state-growth
+# ledger the checkpoint/compaction work will be accepted against. Each
+# leg runs a WAL-backed host testnet under fixed load, samples the
+# capacity families over real HTTP on an interval, and fits
+# bytes-per-committed-event slopes for total retained state, the
+# process RSS, and the WAL — plus the named top-growing component from
+# /debug/capacity. bench_compare gates the slopes against the
+# committed RETENTION_SMOKE.json.
+# --------------------------------------------------------------------------
+
+
+def retention_leg(n, wall_s, scrape_s, ts_file):
+    """One retention leg: n host nodes over WAL-backed FileStores
+    under continuous load for `wall_s`, capacity families scraped over
+    real HTTP every `scrape_s` into the JSONL ledger `ts_file`.
+    Returns the leg summary with the fitted growth slopes."""
+    import threading
+    import urllib.request
+
+    from babble_tpu.service import Service
+    from babble_tpu.telemetry import promtext
+    from babble_tpu.telemetry.capacity import GrowthTracker
+
+    interval = 0.5 if n >= 16 else 0.0
+    nodes = build_host_testnet(n, engine="host", interval=interval,
+                               heartbeat=0.0015, store="file")
+    svc = Service("127.0.0.1:0", nodes[0])
+    svc.serve_async()
+    stop = threading.Event()
+
+    def bombard():
+        i = 0
+        while not stop.is_set():
+            try:
+                nodes[i % n].submit_tx(f"retention tx {i}".encode())
+            except Exception:  # noqa: BLE001
+                pass
+            i += 1
+            time.sleep(0.002)
+
+    committed = lambda: min(  # noqa: E731
+        len(nd.core.get_consensus_events()) for nd in nodes)
+
+    # The slope fitter the node itself uses — one model, two callers.
+    growth = GrowthTracker(window=4096)
+    samples_taken = 0
+    import sys as _sys
+    old_switch = _sys.getswitchinterval()
+    _sys.setswitchinterval(0.1)
+    try:
+        for nd in nodes:
+            nd.run_async(gossip=True)
+        threading.Thread(target=bombard, daemon=True).start()
+        deadline = time.monotonic() + max(6.0, wall_s / 3.0, 3.0 * n)
+        while time.monotonic() < deadline and committed() < 100:
+            time.sleep(0.25)
+        c0, t0 = committed(), time.monotonic()
+        with open(ts_file, "a") as ts:
+            while time.monotonic() - t0 < wall_s:
+                time.sleep(scrape_s)
+                now = round(time.monotonic() - t0, 2)
+                ev = committed()
+                # Real HTTP scrape — the same bytes Prometheus would
+                # ingest, parse-validated.
+                with urllib.request.urlopen(
+                        f"http://{svc.addr}/metrics", timeout=10) as r:
+                    samples, _ = promtext.parse(r.read().decode())
+                node0 = lambda fam: {  # noqa: E731
+                    lb.get("component") or lb.get("file") or "": v
+                    for lb, v in samples.get(fam, [])
+                    if lb.get("node", "0") == "0"}
+                mem = node0("babble_mem_bytes")
+                files = node0("babble_store_bytes")
+                rss_rows = samples.get("babble_process_rss_bytes", [])
+                rss = rss_rows[0][1] if rss_rows else 0
+                mem_total = sum(mem.values())
+                # x = committed events: the slopes read directly as
+                # bytes per committed event.
+                growth.observe("mem_total", ev, mem_total)
+                growth.observe("rss", ev, rss)
+                if "wal" in files:
+                    growth.observe("wal", ev, files["wal"])
+                if "journal" in files:
+                    growth.observe("journal", ev, files["journal"])
+                for comp, b in mem.items():
+                    growth.observe(f"mem:{comp}", ev, b)
+                ts.write(json.dumps({
+                    "t": now, "n": n, "node": "capacity",
+                    "committed_events": ev,
+                    "mem_total_bytes": int(mem_total),
+                    "rss_bytes": int(rss),
+                    "files": {k: int(v) for k, v in files.items()},
+                    "components": {k: int(v) for k, v in mem.items()},
+                }) + "\n")
+                samples_taken += 1
+        wall = time.monotonic() - t0
+        c1 = committed()
+        # Final /debug/capacity read while the net is live: the ranked
+        # top-growers table names the verdict component.
+        try:
+            with urllib.request.urlopen(
+                    f"http://{svc.addr}/debug/capacity", timeout=10) \
+                    as r:
+                cap_dbg = json.loads(r.read())
+        except Exception:  # noqa: BLE001
+            cap_dbg = {}
+    finally:
+        _sys.setswitchinterval(old_switch)
+        stop.set()
+        for nd in nodes:
+            nd.shutdown()
+        svc.close()
+
+    sl = lambda s: growth.slope(s)  # noqa: E731
+    rnd = lambda v: None if v is None else round(v, 2)  # noqa: E731
+    # Top grower by fitted slope across the per-component series (the
+    # node's own /debug/capacity table rides along as a cross-check).
+    comp_slopes = {s[len("mem:"):]: v for s, v in growth.slopes().items()
+                   if s.startswith("mem:") and v is not None}
+    top = max(comp_slopes.items(), key=lambda kv: kv[1]) \
+        if comp_slopes else (None, None)
+    leg = {
+        "n": n,
+        "wall_s": round(wall, 1),
+        "cpus_effective": _cpus_effective(),
+        "runtime": _runtime_arg(),
+        "events_per_s": round((c1 - c0) / wall, 1),
+        "committed_events": c1,
+        "samples": samples_taken,
+        "bytes_per_event": rnd(sl("mem_total")),
+        "rss_slope_bytes_per_event": rnd(sl("rss")),
+        "wal_slope_bytes_per_event": rnd(sl("wal")),
+        "journal_slope_bytes_per_event": rnd(sl("journal")),
+        "mem_total_bytes": (int(growth.last("mem_total"))
+                           if growth.last("mem_total") else 0),
+        "rss_bytes": (int(growth.last("rss"))
+                      if growth.last("rss") else 0),
+        "top_grower": top[0],
+        "top_grower_bytes_per_event": rnd(top[1]),
+        "component_slopes": {k: round(v, 2)
+                             for k, v in sorted(
+                                 comp_slopes.items(),
+                                 key=lambda kv: -kv[1])},
+        "debug_top_growers": (cap_dbg.get("top_growers") or [])[:5],
+    }
+    return leg
+
+
+def retention():
+    """`bench.py --retention`: the retention soak ledger. Legs and
+    wall come from RETENTION_NS / RETENTION_WALL_S /
+    RETENTION_SCRAPE_S (defaults n∈{3,8}, 60 s, 2 s) so CI can run the
+    same shape it gates against the committed RETENTION_SMOKE.json.
+    Emits one JSON payload; raw per-scrape rows land in
+    RETENTION_OUT_DIR."""
+    import tempfile
+
+    ns = [int(x) for x in os.environ.get(
+        "RETENTION_NS", "3,8").split(",") if x.strip()]
+    wall_s = float(os.environ.get("RETENTION_WALL_S", "60"))
+    scrape_s = float(os.environ.get("RETENTION_SCRAPE_S", "2.0"))
+    out_dir = os.environ.get("RETENTION_OUT_DIR") or tempfile.mkdtemp(
+        prefix="babble-retention-")
+    os.makedirs(out_dir, exist_ok=True)
+    ts_file = os.path.join(out_dir, "retention_timeseries.jsonl")
+    payload = {
+        "metric": "retention_soak",
+        "unit": "bytes/event",
+        "engine": "host",
+        "store": "file",
+        "runtime": _runtime_arg(),
+        "wall_s_per_leg": wall_s,
+        "timeseries_jsonl": ts_file,
+        "cpus_effective": _cpus_effective(),
+        "legs": {},
+    }
+    try:
+        # The shared machine-speed yardstick (see bench_compare.py) —
+        # only the ev/s context rows normalize by it; the byte slopes
+        # are machine-independent ratios.
+        calib_eps, _, _ = host_engine_events_per_sec(64, 5000)
+        payload["host_events_per_s"] = round(calib_eps, 1)
+        payload["host_events"] = 5000
+    except Exception as exc:  # noqa: BLE001
+        payload["calibration_error"] = str(exc)
+    failures = 0
+    for n in ns:
+        log(f"retention leg n={n}: {wall_s:.0f}s wall, scrape every "
+            f"{scrape_s:.1f}s")
+        try:
+            leg = retention_leg(n, wall_s, scrape_s, ts_file)
+        except Exception as exc:  # noqa: BLE001
+            payload[f"retention{n}_error"] = str(exc)
+            failures += 1
+            _emit(payload)
+            continue
+        payload["legs"][str(n)] = leg
+        for k in ("events_per_s", "bytes_per_event",
+                  "rss_slope_bytes_per_event",
+                  "wal_slope_bytes_per_event", "top_grower"):
+            if leg.get(k) is not None:
+                payload[f"retention{n}_{k}"] = leg[k]
+        log(f"  n={n}: {leg['events_per_s']:,.1f} ev/s, "
+            f"{leg['bytes_per_event']} bytes/event, rss slope "
+            f"{leg['rss_slope_bytes_per_event']}, wal slope "
+            f"{leg['wal_slope_bytes_per_event']}, top grower "
+            f"{leg['top_grower']}")
+        _emit(payload)
+    _emit(payload)
+    return 1 if failures else 0
+
+
 def child():
     import jax
 
@@ -2590,5 +2890,9 @@ if __name__ == "__main__":
         sys.exit(loadgen())
     elif "--soak" in sys.argv:
         sys.exit(gossip_soak())
+    elif "--capacity-overhead" in sys.argv:
+        sys.exit(capacity_overhead())
+    elif "--retention" in sys.argv:
+        sys.exit(retention())
     else:
         main()

@@ -155,6 +155,21 @@ HEADLINES["loadgen_commit_latency_p50_ms"] = "latency-info"
 HEADLINES["loadgen_shed_share"] = "ratio-info"
 HEADLINES["loadgen_wall_s"] = "latency-info"
 
+# Retention soak ledger (bench.py --retention, docs/observability.md
+# "Capacity"): state-growth shape per leg over WAL-backed FileStores.
+# Gated as ratios (bytes per committed event — machine speed cancels
+# out of a per-event byte cost): total retained bytes, the process RSS
+# slope, and the WAL slope. A leak regression shows up as a steeper
+# slope against the committed RETENTION_SMOKE.json baseline; a
+# baseline slope <= 0 (a leg where GC or a WAL checkpoint shrank the
+# series) is machine-skipped by the b <= 0 guard in compare(). ev/s
+# and the named top grower ride as context, not gates.
+for _n in (3, 8, 16):
+    HEADLINES[f"retention{_n}_bytes_per_event"] = "ratio"
+    HEADLINES[f"retention{_n}_rss_slope_bytes_per_event"] = "ratio"
+    HEADLINES[f"retention{_n}_wal_slope_bytes_per_event"] = "ratio"
+    HEADLINES[f"retention{_n}_events_per_s"] = "throughput"
+
 YARDSTICK = "host_events_per_s"
 
 

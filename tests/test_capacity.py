@@ -1,0 +1,375 @@
+"""Capacity observatory tests (docs/observability.md "Capacity"):
+sizer units, the growth-slope fit, cache-efficiency carries, the
+cardinality lint, and the live acceptance — a real 3-node net must
+serve every capacity family over /metrics plus the ranked
+/debug/capacity surface, a --no_capacity net must serve none of it,
+and a FileStore frame reset must shrink the accounted state."""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+import urllib.request
+
+import pytest
+
+from babble_tpu import crypto
+from babble_tpu.common.lru import LRU
+from babble_tpu.common.rolling_index import RollingIndex
+from babble_tpu.gojson import Timestamp
+from babble_tpu.hashgraph import FileStore, InmemStore
+from babble_tpu.hashgraph.event import MEMO_STATS, Event
+from babble_tpu.hashgraph.root import Root
+from babble_tpu.net import InmemTransport
+from babble_tpu.net.inmem_transport import connect_all
+from babble_tpu.node import Node
+from babble_tpu.node.config import test_config as fast_config
+from babble_tpu.proxy import InmemAppProxy
+from babble_tpu.service import Service
+from babble_tpu.telemetry import Registry, promtext
+from babble_tpu.telemetry.capacity import (EVENT_BASE_BYTES,
+                                           GrowthTracker, bytes_bytes,
+                                           event_bytes, gc_snapshot,
+                                           mem_budget_bytes,
+                                           process_memory, sampled_bytes,
+                                           series_counts, str_bytes)
+
+from test_node import CACHE, make_keyed_peers, make_nodes, run_gossip
+from test_store import make_participants, signed_event
+
+CAPACITY_FAMILIES = [
+    "babble_mem_bytes",
+    'babble_mem_bytes{component="store_event_log"}',
+    'babble_mem_bytes{component="consensus_memos"}',
+    "babble_process_rss_bytes",
+    "babble_process_rss_peak_bytes",
+    "babble_mem_budget_bytes",
+    "babble_gc_tracked_objects",
+    "babble_gc_collections",
+    "babble_shm_bytes",
+    'babble_cache_hits_total{cache="store_events"}',
+    'babble_cache_misses_total{cache="store_events"}',
+    'babble_cache_hits_total{cache="pub_key"}',
+    "babble_telemetry_series",
+    "babble_telemetry_series_total",
+]
+
+
+# ---------------------------------------------------------------- sizers
+
+
+def test_process_memory_and_budget():
+    pm = process_memory()
+    assert pm["rss_bytes"] > 0
+    assert pm["rss_peak_bytes"] >= pm["rss_bytes"] * 0  # present
+    assert mem_budget_bytes() > 0  # cgroup limit or MemTotal
+    snap = gc_snapshot()
+    assert len(snap["gen_counts"]) == 3
+
+
+def test_string_and_bytes_sizers():
+    assert str_bytes(None) == 0
+    assert str_bytes("") == 0
+    assert str_bytes("abcd") == 49 + 4
+    assert bytes_bytes(None) == 0
+    assert bytes_bytes(b"abcd") == 33 + 4
+
+
+def test_event_bytes_counts_payload_and_memos():
+    keys, pubs, _parts = make_participants(2)
+    ev = signed_event(keys[0], pubs[0], ["", ""], 0, 10**18)
+    base = event_bytes(ev)
+    assert base >= EVENT_BASE_BYTES
+    # Materializing the memoized encodings grows the estimate: the
+    # sizer bills retained state, not just the object graph.
+    ev.marshal()
+    ev.hash()
+    assert event_bytes(ev) > base
+    # Never raises, even on junk.
+    assert event_bytes(object()) == EVENT_BASE_BYTES
+
+
+def test_sampled_bytes_exact_and_scaled():
+    vals = [b"x" * 10] * 8
+    exact = sampled_bytes(vals, 8, len, sample=256)
+    assert exact == 80
+    # Above the sample bound the estimate scales from the sampled
+    # prefix — exact here because entries are uniform.
+    scaled = sampled_bytes(iter([b"x" * 10] * 1000), 1000, len, sample=4)
+    assert scaled == 10_000
+    assert sampled_bytes([], 0, len) == 0
+
+
+# ----------------------------------------------------------- growth model
+
+
+def test_growth_tracker_slope_exact_on_linear_series():
+    g = GrowthTracker(window=16)
+    for x in range(10):
+        g.observe("wal", x, 100.0 * x + 5.0)
+    assert g.slope("wal") == pytest.approx(100.0)
+    assert g.last("wal") == pytest.approx(905.0)
+    # bytes to budget at the fitted slope
+    assert g.to_budget("wal", 10_905.0) == pytest.approx(100.0)
+
+
+def test_growth_tracker_dedups_same_x_and_bounds_series():
+    g = GrowthTracker(window=4, max_series=2)
+    g.observe("a", 1, 10)
+    g.observe("a", 1, 20)  # same commit tick: keep freshest
+    assert g.last("a") == 20
+    assert g.slope("a") is None  # one distinct x
+    g.observe("b", 1, 1)
+    g.observe("c", 1, 1)  # over max_series: dropped
+    assert sorted(g.series()) == ["a", "b"]
+    for x in range(2, 20):
+        g.observe("a", x, x)
+    assert len(g._series["a"]) == 4  # windowed
+
+
+def test_growth_tracker_flat_and_shrinking():
+    g = GrowthTracker()
+    for x in range(5):
+        g.observe("flat", x, 7.0)
+        g.observe("down", x, -3.0 * x)
+    assert g.slope("flat") == pytest.approx(0.0)
+    assert g.slope("down") == pytest.approx(-3.0)
+    assert g.to_budget("flat", 100.0) is None  # not growing
+    assert g.to_budget("down", 100.0) is None
+
+
+# ------------------------------------------------------- efficiency carries
+
+
+def test_lru_hit_miss_eviction_counters():
+    lru = LRU(2)
+    lru.add("a", 1)
+    lru.add("b", 2)
+    assert lru.get("a") == (1, True)
+    assert lru.get("zz") == (None, False)
+    lru.add("c", 3)  # evicts b
+    assert (lru.hits, lru.misses, lru.evictions) == (1, 1, 1)
+    # update-in-place is not an eviction
+    lru.add("c", 4)
+    assert lru.evictions == 1
+
+
+def test_rolling_index_eviction_counter():
+    ri = RollingIndex(2)  # capacity 4, rolls by dropping oldest 2
+    for i in range(4):
+        ri.add(f"e{i}", i)
+    assert ri.evicted == 0
+    ri.add("e4", 4)
+    assert ri.evicted == 2
+
+
+def test_event_memo_stats_count_marshal_and_hash_reuse():
+    keys, pubs, _parts = make_participants(2)
+    ev = signed_event(keys[0], pubs[0], ["", ""], 0, 10**18)
+    before = MEMO_STATS.snapshot()
+    ev.marshal()
+    ev.marshal()
+    ev.hash()
+    ev.hash()
+    after = MEMO_STATS.snapshot()
+    assert after["marshal_misses"] - before["marshal_misses"] >= 1
+    assert after["marshal_hits"] - before["marshal_hits"] >= 1
+    assert after["hash_misses"] - before["hash_misses"] >= 1
+    assert after["hash_hits"] - before["hash_hits"] >= 1
+
+
+# ------------------------------------------------------- cardinality audit
+
+
+def test_series_counts_across_registries():
+    r1, r2 = Registry(), Registry()
+    r1.gauge("babble_x", "x", node="0").set(1)
+    r1.gauge("babble_x", "x", node="1").set(1)
+    r2.gauge("babble_x", "x", node="2").set(1)
+    r2.counter("babble_y", "y").inc()
+    counts = series_counts(r1, r2)
+    assert counts["babble_x"] == 3
+    assert counts["babble_y"] == 1
+
+
+def test_promtext_family_series_counts_folds_histograms():
+    text = "\n".join([
+        'babble_g{node="0"} 1',
+        'babble_g{node="1"} 2',
+        'babble_h_bucket{node="0",le="0.1"} 1',
+        'babble_h_bucket{node="0",le="+Inf"} 2',
+        'babble_h_sum{node="0"} 0.3',
+        'babble_h_count{node="0"} 2',
+    ])
+    samples, _ = promtext.parse(text)
+    counts = promtext.family_series_counts(samples)
+    # two gauge children; ONE histogram child (le stripped, the
+    # _bucket/_sum/_count sample names fold onto the family)
+    assert counts["babble_g"] == 2
+    assert counts["babble_h"] == 1
+
+
+def test_promtext_max_series_lint(monkeypatch, capsys):
+    text = "\n".join(f'babble_fat{{peer="{i}"}} 1' for i in range(5))
+    monkeypatch.setattr("sys.stdin", io.StringIO(text))
+    assert promtext.main(["--max-series", "4"]) == 1
+    assert "babble_fat" in capsys.readouterr().err
+    monkeypatch.setattr("sys.stdin", io.StringIO(text))
+    assert promtext.main(["--max-series", "5"]) == 0
+
+
+# --------------------------------------------------------- store accounting
+
+
+def _fill_store(store, keys, pubs, n_events=40):
+    heads = {p: "" for p in pubs}
+    seqs = {p: -1 for p in pubs}
+    ts = 10**18
+    for i in range(n_events):
+        p = pubs[i % len(pubs)]
+        seqs[p] += 1
+        ts += 1
+        ev = signed_event(keys[i % len(pubs)], p,
+                          [heads[p], ""], seqs[p], ts)
+        store.set_event(ev)
+        heads[p] = ev.hex()
+
+
+def test_inmem_store_capacity_stats_accounts_events():
+    keys, pubs, participants = make_participants(2)
+    store = InmemStore(participants, 100)
+    empty = store.capacity_stats()
+    assert empty["components"]["store_event_log"]["rows"] == 0
+    _fill_store(store, keys, pubs)
+    stats = store.capacity_stats()
+    log = stats["components"]["store_event_log"]
+    assert log["rows"] == 40
+    assert log["bytes"] > 40 * EVENT_BASE_BYTES
+    assert stats["caches"]["store_events"]["misses"] >= 0
+
+
+def test_file_store_capacity_shrinks_after_reset(tmp_path):
+    keys, pubs, participants = make_participants(2)
+    fs = FileStore(participants, 100, str(tmp_path / "cap.db"))
+    fs.begin_batch()
+    _fill_store(fs, keys, pubs)
+    fs.commit_batch()
+    before = fs.capacity_stats()
+    assert before["components"]["store_event_log"]["rows"] == 40
+    assert before["files"]["db"] > 0
+    db_before = before["files"]["db"]
+    # Frame reset drops pre-reset history (db + hot cache): the
+    # accounted state must shrink with it — the one shrink path the
+    # growth model should ever see from the store.
+    fs.reset({p: Root() for p in pubs})
+    after = fs.capacity_stats()
+    assert after["components"]["store_event_log"]["rows"] == 0
+    assert after["components"]["store_event_log"]["bytes"] < \
+        before["components"]["store_event_log"]["bytes"]
+    assert after["files"]["db"] <= db_before
+    fs.close()
+
+
+# ------------------------------------------------------- live acceptance
+
+
+def _scrape(svc):
+    with urllib.request.urlopen(
+            f"http://{svc.addr}/metrics", timeout=10) as r:
+        return promtext.parse(r.read().decode())
+
+
+def test_live_capacity_scrape_and_debug_surface():
+    """A live 3-node net serves every capacity family over /metrics,
+    and /debug/capacity returns the assembled snapshot: components,
+    cache efficiency (including the process-wide pub-key LRU and
+    event memos), growth slopes, and the ranked top-growers table."""
+    nodes = make_nodes(3, "inmem")
+    svc = None
+    try:
+        svc = Service("127.0.0.1:0", nodes[0])
+        svc.serve_async()
+        run_gossip(nodes, target_round=2, shutdown=False)
+        samples, _ = _scrape(svc)
+        missing = promtext.check_series(samples, CAPACITY_FAMILIES)
+        assert not missing, missing
+        # every exported component byte gauge is non-negative
+        for lb, v in samples["babble_mem_bytes"]:
+            assert v >= 0, lb
+        # the live scrape passes the cardinality lint: no family fans
+        # out past a sane per-family ceiling (a per-event or
+        # per-digest label would blow straight through this)
+        fat = {f: c for f, c in
+               promtext.family_series_counts(samples).items() if c > 200}
+        assert not fat, fat
+        with urllib.request.urlopen(
+                f"http://{svc.addr}/debug/capacity", timeout=10) as r:
+            cap = json.loads(r.read())
+        assert cap["enabled"] is True
+        assert cap["components"]["store_event_log"]["rows"] > 0
+        assert cap["process"]["rss_bytes"] > 0
+        assert "pub_key" in cap["caches"]
+        assert "event_marshal" in cap["caches"]
+        assert cap["caches"]["store_events"]["hits"] >= 0
+        assert cap["series"]["total"] > 0
+        assert isinstance(cap["top_growers"], list)
+        # a second read a beat later grows the slope window
+        time.sleep(0.2)
+        with urllib.request.urlopen(
+                f"http://{svc.addr}/debug/capacity", timeout=10) as r:
+            cap2 = json.loads(r.read())
+        assert cap2["committed_block"] >= cap["committed_block"]
+    finally:
+        if svc is not None:
+            svc.close()
+        for nd in nodes:
+            nd.shutdown()
+
+
+def _build_net_no_capacity(n=3):
+    transports = [InmemTransport(f"addr{i}", timeout=2.0)
+                  for i in range(n)]
+    connect_all(transports)
+    entries = make_keyed_peers(n, addr_fn=lambda i: f"addr{i}")
+    by_addr = {t.local_addr(): t for t in transports}
+    peers = [p for _, p in entries]
+    participants = {p.pub_key_hex: i for i, p in enumerate(peers)}
+    nodes = []
+    for i, (key, peer) in enumerate(entries):
+        conf = fast_config(heartbeat=0.01)
+        conf.capacity = False
+        store = InmemStore(participants, CACHE)
+        node = Node(conf, i, key, peers, store,
+                    by_addr[peer.net_addr], InmemAppProxy())
+        node.init()
+        nodes.append(node)
+    return nodes
+
+
+def test_no_capacity_kill_switch_exports_nothing():
+    """--no_capacity parity: the scrape carries no capacity families
+    from this node and /debug/capacity answers {"enabled": false} —
+    the whole plane is a strict no-op."""
+    nodes = _build_net_no_capacity()
+    svc = None
+    try:
+        svc = Service("127.0.0.1:0", nodes[0])
+        svc.serve_async()
+        run_gossip(nodes, target_round=2, shutdown=False)
+        samples, _ = _scrape(svc)
+        node_label = str(nodes[0].id)
+        for fam in ("babble_mem_bytes", "babble_growth_bytes_per_block",
+                    "babble_telemetry_series", "babble_store_bytes"):
+            owned = [lb for lb, _v in samples.get(fam, [])
+                     if lb.get("node") == node_label]
+            assert not owned, (fam, owned)
+        with urllib.request.urlopen(
+                f"http://{svc.addr}/debug/capacity", timeout=10) as r:
+            cap = json.loads(r.read())
+        assert cap == {"enabled": False}
+    finally:
+        if svc is not None:
+            svc.close()
+        for nd in nodes:
+            nd.shutdown()
